@@ -1,0 +1,289 @@
+// torchft_tpu native core — C ABI for Python ctypes bindings.
+//
+// The reference exposes its Rust core to Python via pyo3
+// (/root/reference/src/lib.rs). pybind11 isn't available in this image, so
+// we expose a small C ABI instead and keep the binding layer in
+// torchft_tpu/_native/__init__.py. Complex values (RPC requests/responses,
+// pure-function inputs) travel as wire-codec buffers (wire.h), which the
+// Python side encodes/decodes with torchft_tpu/utils/wire.py.
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "coord.h"
+#include "rpc.h"
+#include "wire.h"
+
+using namespace tft;
+
+namespace {
+
+std::mutex g_mu;
+int64_t g_next = 1;
+std::map<int64_t, std::unique_ptr<Lighthouse>> g_lighthouses;
+std::map<int64_t, std::unique_ptr<ManagerSrv>> g_managers;
+std::map<int64_t, std::unique_ptr<KvStore>> g_stores;
+std::map<int64_t, std::unique_ptr<RpcClient>> g_clients;
+
+void set_err(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    strncpy(err, msg.c_str(), (size_t)errlen - 1);
+    err[errlen - 1] = '\0';
+  }
+}
+
+void copy_str(const std::string& s, char* buf, int buflen) {
+  if (buf && buflen > 0) {
+    strncpy(buf, s.c_str(), (size_t)buflen - 1);
+    buf[buflen - 1] = '\0';
+  }
+}
+
+uint8_t* alloc_out(const std::string& s, int64_t* outlen) {
+  uint8_t* p = (uint8_t*)malloc(s.size());
+  if (p) memcpy(p, s.data(), s.size());
+  *outlen = (int64_t)s.size();
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- buffers ----
+void tft_buf_free(uint8_t* p) { free(p); }
+
+// ---- lighthouse ----
+int64_t tft_lighthouse_create(const char* bind, uint64_t min_replicas,
+                              uint64_t join_timeout_ms, uint64_t quorum_tick_ms,
+                              uint64_t heartbeat_timeout_ms, char* err,
+                              int errlen) {
+  try {
+    LighthouseOpt opt;
+    opt.min_replicas = min_replicas;
+    opt.join_timeout_ms = join_timeout_ms;
+    opt.quorum_tick_ms = quorum_tick_ms;
+    opt.heartbeat_timeout_ms = heartbeat_timeout_ms;
+    auto lh = std::make_unique<Lighthouse>(bind, opt);
+    std::lock_guard<std::mutex> g(g_mu);
+    int64_t h = g_next++;
+    g_lighthouses[h] = std::move(lh);
+    return h;
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return 0;
+  }
+}
+
+void tft_lighthouse_address(int64_t h, char* buf, int buflen) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_lighthouses.find(h);
+  copy_str(it != g_lighthouses.end() ? it->second->address() : "", buf, buflen);
+}
+
+void tft_lighthouse_shutdown(int64_t h) {
+  std::unique_ptr<Lighthouse> lh;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_lighthouses.find(h);
+    if (it == g_lighthouses.end()) return;
+    lh = std::move(it->second);
+    g_lighthouses.erase(it);
+  }
+  lh->shutdown();
+}
+
+// ---- manager ----
+int64_t tft_manager_create(const char* replica_id, const char* lighthouse_addr,
+                           const char* hostname, const char* bind,
+                           const char* store_addr, uint64_t world_size,
+                           int64_t heartbeat_interval_ms,
+                           int64_t connect_timeout_ms, char* err, int errlen) {
+  try {
+    auto m = std::make_unique<ManagerSrv>(
+        replica_id, lighthouse_addr, hostname, bind, store_addr, world_size,
+        heartbeat_interval_ms, connect_timeout_ms);
+    std::lock_guard<std::mutex> g(g_mu);
+    int64_t h = g_next++;
+    g_managers[h] = std::move(m);
+    return h;
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return 0;
+  }
+}
+
+void tft_manager_address(int64_t h, char* buf, int buflen) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_managers.find(h);
+  copy_str(it != g_managers.end() ? it->second->address() : "", buf, buflen);
+}
+
+void tft_manager_shutdown(int64_t h) {
+  std::unique_ptr<ManagerSrv> m;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_managers.find(h);
+    if (it == g_managers.end()) return;
+    m = std::move(it->second);
+    g_managers.erase(it);
+  }
+  m->shutdown();
+}
+
+// ---- kv store ----
+int64_t tft_store_create(const char* bind, char* err, int errlen) {
+  try {
+    auto s = std::make_unique<KvStore>(bind);
+    std::lock_guard<std::mutex> g(g_mu);
+    int64_t h = g_next++;
+    g_stores[h] = std::move(s);
+    return h;
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return 0;
+  }
+}
+
+void tft_store_address(int64_t h, char* buf, int buflen) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_stores.find(h);
+  copy_str(it != g_stores.end() ? it->second->address() : "", buf, buflen);
+}
+
+void tft_store_shutdown(int64_t h) {
+  std::unique_ptr<KvStore> s;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_stores.find(h);
+    if (it == g_stores.end()) return;
+    s = std::move(it->second);
+    g_stores.erase(it);
+  }
+  s->shutdown();
+}
+
+// ---- generic RPC client ----
+// Returns handle > 0, or 0 with err set.
+int64_t tft_client_create(const char* addr, int64_t connect_timeout_ms,
+                          char* err, int errlen) {
+  try {
+    auto c = std::make_unique<RpcClient>(addr, connect_timeout_ms);
+    std::lock_guard<std::mutex> g(g_mu);
+    int64_t h = g_next++;
+    g_clients[h] = std::move(c);
+    return h;
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return 0;
+  }
+}
+
+// Returns the RPC status code (0 = OK). On OK, *out/*outlen hold the encoded
+// response map (caller frees with tft_buf_free). On failure err holds the
+// message.
+int64_t tft_client_call(int64_t h, const char* method, const uint8_t* req,
+                        int64_t reqlen, int64_t timeout_ms, uint8_t** out,
+                        int64_t* outlen, char* err, int errlen) {
+  RpcClient* c = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_clients.find(h);
+    if (it == g_clients.end()) {
+      set_err(err, errlen, "bad client handle");
+      return INVALID_ARGUMENT;
+    }
+    c = it->second.get();
+  }
+  try {
+    Value v = req && reqlen > 0 ? decode(req, (size_t)reqlen) : Value::M();
+    Value resp = c->call(method, std::move(v), timeout_ms);
+    std::string enc = encode(resp);
+    *out = alloc_out(enc, outlen);
+    return OK;
+  } catch (const RpcError& e) {
+    set_err(err, errlen, e.what());
+    return e.code;
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return INTERNAL;
+  }
+}
+
+void tft_client_free(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_clients.erase(h);
+}
+
+// ---- pure decision procedures (for unit tests, mirroring the reference's
+// in-file Rust tests of quorum_compute / compute_quorum_results) ----
+
+// state_buf encodes:
+// { now: I64, participants: [{joined_ms, member}], heartbeats: [{replica_id,
+//   at_ms}], prev_quorum: quorum|none,
+//   opt: {min_replicas, join_timeout_ms, heartbeat_timeout_ms} }
+// Response: { quorum: [member]|none, reason: str }
+int64_t tft_quorum_compute(const uint8_t* state_buf, int64_t len, uint8_t** out,
+                           int64_t* outlen, char* err, int errlen) {
+  try {
+    Value v = decode(state_buf, (size_t)len);
+    LighthouseState st;
+    int64_t now = v.geti("now");
+    if (v.has("participants"))
+      for (const auto& p : v.at("participants").list)
+        st.participants[p.at("member").gets("replica_id")] = MemberDetails{
+            p.geti("joined_ms"), QuorumMember::from_value(p.at("member"))};
+    if (v.has("heartbeats"))
+      for (const auto& hb : v.at("heartbeats").list)
+        st.heartbeats[hb.gets("replica_id")] = hb.geti("at_ms");
+    if (v.has("prev_quorum") && !v.at("prev_quorum").is_none())
+      st.prev_quorum = Quorum::from_value(v.at("prev_quorum"));
+    LighthouseOpt opt;
+    if (v.has("opt")) {
+      const Value& o = v.at("opt");
+      opt.min_replicas = (uint64_t)o.geti("min_replicas", 1);
+      opt.join_timeout_ms = (uint64_t)o.geti("join_timeout_ms", 60000);
+      opt.heartbeat_timeout_ms = (uint64_t)o.geti("heartbeat_timeout_ms", 5000);
+    }
+    auto [met, reason] = quorum_compute(now, st, opt);
+    Value resp = Value::M();
+    if (met.has_value()) {
+      Value l = Value::L();
+      for (const auto& m : *met) l.list.push_back(m.to_value());
+      resp.set("quorum", l);
+    } else {
+      resp.set("quorum", Value::None());
+    }
+    resp.set("reason", Value::S(reason));
+    std::string enc = encode(resp);
+    *out = alloc_out(enc, outlen);
+    return OK;
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return INTERNAL;
+  }
+}
+
+// quorum_buf encodes a Quorum value. Response: ManagerQuorumResult map.
+int64_t tft_compute_quorum_results(const uint8_t* quorum_buf, int64_t len,
+                                   const char* replica_id, int64_t rank,
+                                   uint8_t** out, int64_t* outlen, char* err,
+                                   int errlen) {
+  try {
+    Quorum q = Quorum::from_value(decode(quorum_buf, (size_t)len));
+    ManagerQuorumResult res = compute_quorum_results(replica_id, rank, q);
+    std::string enc = encode(res.to_value());
+    *out = alloc_out(enc, outlen);
+    return OK;
+  } catch (const RpcError& e) {
+    set_err(err, errlen, e.what());
+    return e.code;
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return INTERNAL;
+  }
+}
+
+}  // extern "C"

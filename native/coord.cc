@@ -1,0 +1,777 @@
+#include "coord.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <sstream>
+
+namespace tft {
+
+static int64_t wall_ms() {
+  using namespace std::chrono;
+  return duration_cast<milliseconds>(system_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string get_hostname() {
+  char buf[256];
+  if (gethostname(buf, sizeof(buf)) != 0) return "localhost";
+  buf[sizeof(buf) - 1] = '\0';
+  return buf;
+}
+
+static void logline(const std::string& msg) {
+  fprintf(stderr, "[tftcore %lld] %s\n", (long long)wall_ms(), msg.c_str());
+}
+
+// ---- value conversions ----------------------------------------------------
+
+Value QuorumMember::to_value() const {
+  Value v = Value::M();
+  v.set("replica_id", Value::S(replica_id));
+  v.set("address", Value::S(address));
+  v.set("store_address", Value::S(store_address));
+  v.set("step", Value::I(step));
+  v.set("world_size", Value::I((int64_t)world_size));
+  v.set("shrink_only", Value::B(shrink_only));
+  return v;
+}
+
+QuorumMember QuorumMember::from_value(const Value& v) {
+  QuorumMember m;
+  m.replica_id = v.gets("replica_id");
+  m.address = v.gets("address");
+  m.store_address = v.gets("store_address");
+  m.step = v.geti("step");
+  m.world_size = (uint64_t)v.geti("world_size");
+  m.shrink_only = v.getb("shrink_only");
+  return m;
+}
+
+Value Quorum::to_value() const {
+  Value v = Value::M();
+  v.set("quorum_id", Value::I(quorum_id));
+  Value parts = Value::L();
+  for (const auto& p : participants) parts.list.push_back(p.to_value());
+  v.set("participants", parts);
+  v.set("created", Value::I(created_unix_ms));
+  return v;
+}
+
+Quorum Quorum::from_value(const Value& v) {
+  Quorum q;
+  q.quorum_id = v.geti("quorum_id");
+  q.created_unix_ms = v.geti("created");
+  if (v.has("participants"))
+    for (const auto& p : v.at("participants").list)
+      q.participants.push_back(QuorumMember::from_value(p));
+  return q;
+}
+
+Value ManagerQuorumResult::to_value() const {
+  Value v = Value::M();
+  v.set("quorum_id", Value::I(quorum_id));
+  v.set("recover_src_manager_address", Value::S(recover_src_manager_address));
+  v.set("recover_src_rank", recover_src_rank.has_value()
+                                ? Value::I(*recover_src_rank)
+                                : Value::None());
+  Value dst = Value::L();
+  for (int64_t r : recover_dst_ranks) dst.list.push_back(Value::I(r));
+  v.set("recover_dst_ranks", dst);
+  v.set("store_address", Value::S(store_address));
+  v.set("max_step", Value::I(max_step));
+  v.set("max_rank", max_rank.has_value() ? Value::I(*max_rank) : Value::None());
+  v.set("max_world_size", Value::I(max_world_size));
+  v.set("replica_rank", Value::I(replica_rank));
+  v.set("replica_world_size", Value::I(replica_world_size));
+  v.set("heal", Value::B(heal));
+  return v;
+}
+
+// ---- pure decision procedures --------------------------------------------
+
+static bool quorum_changed(const std::vector<QuorumMember>& a,
+                           const std::vector<QuorumMember>& b) {
+  // Member *identity* only — step changes don't bump quorum_id
+  // (src/lighthouse.rs:105-110).
+  if (a.size() != b.size()) return true;
+  for (size_t i = 0; i < a.size(); i++)
+    if (a[i].replica_id != b[i].replica_id) return true;
+  return false;
+}
+
+std::pair<std::optional<std::vector<QuorumMember>>, std::string> quorum_compute(
+    int64_t now, const LighthouseState& state, const LighthouseOpt& opt) {
+  std::set<std::string> healthy_replicas;
+  for (const auto& [id, beat] : state.heartbeats)
+    if (now - beat < (int64_t)opt.heartbeat_timeout_ms)
+      healthy_replicas.insert(id);
+
+  // std::map keeps participants sorted by replica_id, giving the consistent
+  // candidate ordering the reference gets via an explicit sort
+  // (src/lighthouse.rs:141-142).
+  std::map<std::string, const MemberDetails*> healthy_participants;
+  for (const auto& [id, det] : state.participants)
+    if (healthy_replicas.count(id)) healthy_participants[id] = &det;
+
+  std::vector<QuorumMember> candidates;
+  candidates.reserve(healthy_participants.size());
+  bool shrink_only = false;
+  for (const auto& [id, det] : healthy_participants) {
+    candidates.push_back(det->member);
+    shrink_only = shrink_only || det->member.shrink_only;
+  }
+
+  std::ostringstream meta;
+  meta << "[" << healthy_participants.size() << "/" << state.participants.size()
+       << " participants healthy][" << healthy_replicas.size()
+       << " heartbeating][shrink_only=" << (shrink_only ? "true" : "false")
+       << "]";
+  std::string metadata = meta.str();
+
+  if (state.prev_quorum.has_value()) {
+    const Quorum& prev = *state.prev_quorum;
+    std::set<std::string> prev_ids;
+    for (const auto& p : prev.participants) prev_ids.insert(p.replica_id);
+
+    if (shrink_only) {
+      std::vector<QuorumMember> filtered;
+      for (auto& c : candidates)
+        if (prev_ids.count(c.replica_id)) filtered.push_back(c);
+      candidates = std::move(filtered);
+    }
+
+    bool is_fast = true;
+    for (const auto& p : prev.participants)
+      if (!healthy_participants.count(p.replica_id)) {
+        is_fast = false;
+        break;
+      }
+    if (is_fast)
+      return {candidates, "Fast quorum found! " + metadata};
+  }
+
+  if (healthy_participants.size() < opt.min_replicas)
+    return {std::nullopt,
+            "New quorum not ready, only have " +
+                std::to_string(healthy_participants.size()) +
+                " participants, need min_replicas " +
+                std::to_string(opt.min_replicas) + " " + metadata};
+
+  // Split-brain guard: require a strict majority of heartbeating replicas
+  // (src/lighthouse.rs:202-213).
+  if (healthy_participants.size() <= healthy_replicas.size() / 2)
+    return {std::nullopt,
+            "New quorum not ready, only have " +
+                std::to_string(healthy_participants.size()) +
+                " participants, need at least half of " +
+                std::to_string(healthy_replicas.size()) + " healthy workers " +
+                metadata};
+
+  bool all_healthy_joined =
+      healthy_participants.size() == healthy_replicas.size();
+  int64_t first_joined = now;
+  for (const auto& [id, det] : healthy_participants)
+    first_joined = std::min(first_joined, det->joined_ms);
+  if (!all_healthy_joined &&
+      now - first_joined < (int64_t)opt.join_timeout_ms)
+    return {std::nullopt,
+            "Valid quorum with " +
+                std::to_string(healthy_participants.size()) +
+                " participants, waiting for " +
+                std::to_string(healthy_replicas.size() -
+                               healthy_participants.size()) +
+                " healthy but not participating stragglers due to join "
+                "timeout " +
+                metadata};
+
+  return {candidates, "Valid quorum found " + metadata};
+}
+
+ManagerQuorumResult compute_quorum_results(const std::string& replica_id,
+                                           int64_t rank,
+                                           const Quorum& quorum) {
+  std::vector<QuorumMember> participants = quorum.participants;
+  std::sort(participants.begin(), participants.end(),
+            [](const QuorumMember& a, const QuorumMember& b) {
+              return a.replica_id < b.replica_id;
+            });
+
+  int64_t replica_rank = -1;
+  for (size_t i = 0; i < participants.size(); i++)
+    if (participants[i].replica_id == replica_id) {
+      replica_rank = (int64_t)i;
+      break;
+    }
+  if (replica_rank < 0)
+    throw RpcError(NOT_FOUND, "replica " + replica_id +
+                                  " not participating in returned quorum");
+
+  int64_t max_step = 0;
+  for (const auto& p : participants) max_step = std::max(max_step, p.step);
+
+  std::vector<size_t> max_idx;  // indices of members at max step
+  for (size_t i = 0; i < participants.size(); i++)
+    if (participants[i].step == max_step) max_idx.push_back(i);
+
+  std::optional<int64_t> max_rank;
+  for (size_t i = 0; i < max_idx.size(); i++)
+    if (participants[max_idx[i]].replica_id == replica_id) {
+      max_rank = (int64_t)i;
+      break;
+    }
+
+  // The primary store for this local rank, striped over the max-step cohort
+  // (src/manager.rs:397-399).
+  const QuorumMember& primary =
+      participants[max_idx[(size_t)rank % max_idx.size()]];
+
+  // recover_dst: behind the max step, or (first step and not primary) —
+  // src/manager.rs:403-416.
+  std::vector<size_t> all_recover_dst;
+  for (size_t i = 0; i < participants.size(); i++) {
+    const auto& p = participants[i];
+    if (p.step != max_step ||
+        (max_step == 0 && primary.replica_id != p.replica_id))
+      all_recover_dst.push_back(i);
+  }
+  std::set<size_t> dst_set(all_recover_dst.begin(), all_recover_dst.end());
+  std::vector<size_t> up_to_date;
+  for (size_t i = 0; i < participants.size(); i++)
+    if (!dst_set.count(i)) up_to_date.push_back(i);
+
+  // Round-robin recoverers onto sources, offset by the local rank so
+  // different local ranks fan out over different sources
+  // (src/manager.rs:430-447).
+  std::map<size_t, std::vector<int64_t>> assignments;
+  std::optional<int64_t> recover_src_rank;
+  for (size_t i = 0; i < all_recover_dst.size(); i++) {
+    size_t src = up_to_date[(i + (size_t)rank) % up_to_date.size()];
+    assignments[src].push_back((int64_t)all_recover_dst[i]);
+    if ((int64_t)all_recover_dst[i] == replica_rank)
+      recover_src_rank = (int64_t)src;
+  }
+
+  ManagerQuorumResult out;
+  out.quorum_id = quorum.quorum_id;
+  out.heal = recover_src_rank.has_value();
+  out.recover_src_rank = recover_src_rank;
+  if (recover_src_rank.has_value())
+    out.recover_src_manager_address =
+        participants[(size_t)*recover_src_rank].address;
+  auto it = assignments.find((size_t)replica_rank);
+  if (it != assignments.end()) out.recover_dst_ranks = it->second;
+  out.store_address = primary.store_address;
+  out.max_step = max_step;
+  out.max_rank = max_rank;
+  out.max_world_size = (int64_t)max_idx.size();
+  out.replica_rank = replica_rank;
+  out.replica_world_size = (int64_t)participants.size();
+  return out;
+}
+
+// ---- Lighthouse -----------------------------------------------------------
+
+Lighthouse::Lighthouse(const std::string& bind, const LighthouseOpt& opt)
+    : opt_(opt), hostname_(get_hostname()) {
+  std::string err;
+  bool ok = server_.start(
+      bind,
+      [this](const std::string& m, const Value& r, int64_t d) {
+        return handle_rpc(m, r, d);
+      },
+      [this](const std::string& m, const std::string& p) {
+        return handle_http(m, p);
+      },
+      &err);
+  if (!ok) throw RpcError(UNAVAILABLE, "lighthouse bind failed: " + err);
+  tick_thread_ = std::thread([this] { tick_loop(); });
+  logline("Lighthouse listening on " + address());
+}
+
+Lighthouse::~Lighthouse() { shutdown(); }
+
+void Lighthouse::shutdown() {
+  if (!running_.exchange(false)) return;
+  cv_.notify_all();
+  if (tick_thread_.joinable()) tick_thread_.join();
+  server_.shutdown();
+}
+
+std::string Lighthouse::address() const {
+  return "http://" + hostname_ + ":" + std::to_string(server_.port());
+}
+
+void Lighthouse::tick_loop() {
+  std::unique_lock<std::mutex> lk(mu_);
+  while (running_.load()) {
+    cv_.wait_for(lk, std::chrono::milliseconds(opt_.quorum_tick_ms),
+                 [this] { return !running_.load(); });
+    if (!running_.load()) break;
+    quorum_tick();
+  }
+}
+
+void Lighthouse::quorum_tick() {
+  auto [met, reason] = quorum_compute(now_ms(), state_, opt_);
+  last_reason_ = reason;
+  if (!met.has_value()) return;
+
+  if (!state_.prev_quorum.has_value() ||
+      quorum_changed(*met, state_.prev_quorum->participants)) {
+    state_.quorum_id += 1;
+    logline("Detected quorum change, bumping quorum_id to " +
+            std::to_string(state_.quorum_id));
+  }
+  Quorum q;
+  q.quorum_id = state_.quorum_id;
+  q.participants = *met;
+  q.created_unix_ms = wall_ms();
+
+  state_.prev_quorum = q;
+  state_.participants.clear();
+
+  published_[++quorum_seq_] = q;
+  while (published_.size() > 16) published_.erase(published_.begin());
+  cv_.notify_all();
+}
+
+Value Lighthouse::handle_rpc(const std::string& method, const Value& req,
+                             int64_t deadline) {
+  if (method == "lh.quorum") return handle_quorum(req, deadline);
+  if (method == "lh.heartbeat") {
+    std::lock_guard<std::mutex> g(mu_);
+    state_.heartbeats[req.gets("replica_id")] = now_ms();
+    return Value::M();
+  }
+  throw RpcError(INVALID_ARGUMENT, "unknown method " + method);
+}
+
+Value Lighthouse::handle_quorum(const Value& req, int64_t deadline) {
+  if (!req.has("requester"))
+    throw RpcError(INVALID_ARGUMENT, "missing requester");
+  QuorumMember requester = QuorumMember::from_value(req.at("requester"));
+
+  std::unique_lock<std::mutex> lk(mu_);
+  // Implicit heartbeat + registration (src/lighthouse.rs:455-467).
+  state_.heartbeats[requester.replica_id] = now_ms();
+  state_.participants[requester.replica_id] =
+      MemberDetails{now_ms(), requester};
+  uint64_t seen = quorum_seq_;
+  // Proactive tick so a fast quorum resolves without waiting a full tick
+  // (src/lighthouse.rs:470-473).
+  quorum_tick();
+
+  while (true) {
+    bool ok = cv_.wait_until(
+        lk, std::chrono::steady_clock::time_point(
+                std::chrono::milliseconds(deadline)),
+        [&] { return quorum_seq_ > seen || !running_.load(); });
+    if (!running_.load()) throw RpcError(CANCELLED, "lighthouse shutting down");
+    if (!ok) throw RpcError(DEADLINE_EXCEEDED, "quorum wait timed out");
+    // Deliver published quorums in order; return on the first containing the
+    // requester, else re-register and keep waiting
+    // (src/lighthouse.rs:478-499).
+    while (seen < quorum_seq_) {
+      seen++;
+      auto it = published_.find(seen);
+      if (it == published_.end()) continue;
+      for (const auto& p : it->second.participants)
+        if (p.replica_id == requester.replica_id) {
+          Value out = Value::M();
+          out.set("quorum", it->second.to_value());
+          return out;
+        }
+    }
+    state_.participants[requester.replica_id] =
+        MemberDetails{now_ms(), requester};
+  }
+}
+
+static std::string html_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '<')
+      out += "&lt;";
+    else if (c == '>')
+      out += "&gt;";
+    else if (c == '&')
+      out += "&amp;";
+    else
+      out.push_back(c);
+  }
+  return out;
+}
+
+static std::string http_ok(const std::string& body,
+                           const std::string& ctype = "text/html") {
+  std::ostringstream o;
+  o << "HTTP/1.1 200 OK\r\nContent-Type: " << ctype
+    << "\r\nContent-Length: " << body.size() << "\r\nConnection: close\r\n\r\n"
+    << body;
+  return o.str();
+}
+
+std::string Lighthouse::status_html() {
+  std::unique_lock<std::mutex> lk(mu_);
+  auto [met, reason] = quorum_compute(now_ms(), state_, opt_);
+  (void)met;
+  std::ostringstream o;
+  int64_t max_step = -1;
+  if (state_.prev_quorum)
+    for (const auto& p : state_.prev_quorum->participants)
+      max_step = std::max(max_step, p.step);
+  o << "<h2>Quorum</h2><p>quorum_id: " << state_.quorum_id
+    << "</p><p>status: " << html_escape(reason) << "</p>";
+  if (state_.prev_quorum) {
+    int64_t age_ms = wall_ms() - state_.prev_quorum->created_unix_ms;
+    o << "<p>age: " << age_ms / 1000.0 << "s</p>";
+    o << "<table border=1 cellpadding=4><tr><th>replica_id</th><th>step</th>"
+         "<th>manager</th><th>store</th><th>world_size</th><th></th></tr>";
+    for (const auto& p : state_.prev_quorum->participants) {
+      bool recovering = p.step != max_step;
+      o << "<tr" << (recovering ? " style=\"background:orange\"" : "") << "><td>"
+        << html_escape(p.replica_id) << (recovering ? " (recovering)" : "")
+        << "</td><td>" << p.step << "</td><td>" << html_escape(p.address)
+        << "</td><td>" << html_escape(p.store_address) << "</td><td>"
+        << p.world_size << "</td><td><form method=post action=\"/replica/"
+        << html_escape(p.replica_id)
+        << "/kill\"><button>Kill</button></form></td></tr>";
+    }
+    o << "</table>";
+  } else {
+    o << "<p>No quorum yet.</p>";
+  }
+  o << "<h2>Heartbeats</h2><table border=1 cellpadding=4>"
+       "<tr><th>replica_id</th><th>age</th></tr>";
+  int64_t now = now_ms();
+  for (const auto& [id, beat] : state_.heartbeats) {
+    bool old = now - beat >= (int64_t)opt_.heartbeat_timeout_ms;
+    o << "<tr" << (old ? " style=\"background:orange\"" : "") << "><td>"
+      << html_escape(id) << "</td><td>" << (now - beat) / 1000.0
+      << "s</td></tr>";
+  }
+  o << "</table>";
+  return o.str();
+}
+
+std::string Lighthouse::handle_http(const std::string& method,
+                                    const std::string& path) {
+  if (method == "GET" && path == "/") {
+    return http_ok(
+        "<!doctype html><html><head><title>torchft_tpu lighthouse</title>"
+        "<meta http-equiv=refresh content=1 url=/></head>"
+        "<body><h1>torchft_tpu lighthouse</h1><div id=s></div>"
+        "<script>async function t(){let r=await fetch('/status');"
+        "document.getElementById('s').innerHTML=await r.text();}"
+        "t();setInterval(t,1000);</script></body></html>");
+  }
+  if (method == "GET" && path == "/status") return http_ok(status_html());
+  if (method == "GET" && path == "/status.json") {
+    std::unique_lock<std::mutex> lk(mu_);
+    std::ostringstream o;
+    o << "{\"quorum_id\":" << state_.quorum_id << ",\"num_participants\":"
+      << (state_.prev_quorum ? (int64_t)state_.prev_quorum->participants.size()
+                             : -1)
+      << ",\"heartbeats\":" << state_.heartbeats.size() << "}";
+    return http_ok(o.str(), "application/json");
+  }
+  // POST /replica/{id}/kill → forward to that replica's manager
+  // (src/lighthouse.rs:412-437).
+  const std::string pre = "/replica/";
+  if (method == "POST" && path.rfind(pre, 0) == 0 &&
+      path.size() > pre.size() + 5 &&
+      path.substr(path.size() - 5) == "/kill") {
+    std::string replica_id =
+        path.substr(pre.size(), path.size() - pre.size() - 5);
+    std::string addr;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (state_.prev_quorum)
+        for (const auto& p : state_.prev_quorum->participants)
+          if (p.replica_id == replica_id) addr = p.address;
+    }
+    if (addr.empty()) return http_error_page("failed to find replica");
+    try {
+      RpcClient client(addr, 10000);
+      client.call("mgr.kill", Value::M().set("msg", Value::S("killed from dashboard")),
+                  10000);
+    } catch (const std::exception& e) {
+      return http_error_page(e.what());
+    }
+    return http_ok("ok", "text/plain");
+  }
+  return http_ok("not found", "text/plain");
+}
+
+std::string Lighthouse::http_error_page(const std::string& msg) {
+  std::string body = "Something went wrong: " + msg;
+  std::ostringstream o;
+  o << "HTTP/1.1 500 Error\r\nContent-Type: text/plain\r\nContent-Length: "
+    << body.size() << "\r\nConnection: close\r\n\r\n"
+    << body;
+  return o.str();
+}
+
+// ---- Manager --------------------------------------------------------------
+
+ManagerSrv::ManagerSrv(const std::string& replica_id,
+                       const std::string& lighthouse_addr,
+                       const std::string& hostname, const std::string& bind,
+                       const std::string& store_addr, uint64_t world_size,
+                       int64_t heartbeat_interval_ms,
+                       int64_t connect_timeout_ms)
+    : replica_id_(replica_id),
+      hostname_(hostname.empty() ? get_hostname() : hostname),
+      store_address_(store_addr),
+      lighthouse_addr_(lighthouse_addr),
+      world_size_(world_size),
+      heartbeat_interval_ms_(heartbeat_interval_ms),
+      connect_timeout_ms_(connect_timeout_ms) {
+  // Connect to the lighthouse eagerly; construction fails if unreachable,
+  // matching Manager::new (src/manager.rs:97).
+  lighthouse_client_ =
+      std::make_unique<RpcClient>(lighthouse_addr, connect_timeout_ms);
+  std::string err;
+  bool ok = server_.start(
+      bind,
+      [this](const std::string& m, const Value& r, int64_t d) {
+        return handle_rpc(m, r, d);
+      },
+      nullptr, &err);
+  if (!ok) throw RpcError(UNAVAILABLE, "manager bind failed: " + err);
+  heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
+  logline("Manager " + replica_id_ + " listening on " + address());
+}
+
+ManagerSrv::~ManagerSrv() { shutdown(); }
+
+void ManagerSrv::shutdown() {
+  if (!running_.exchange(false)) return;
+  cv_.notify_all();
+  if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+  server_.shutdown();
+}
+
+std::string ManagerSrv::address() const {
+  return "http://" + hostname_ + ":" + std::to_string(server_.port());
+}
+
+void ManagerSrv::heartbeat_loop() {
+  // Own connection so the long-poll quorum call on lighthouse_client_
+  // never delays heartbeats (src/manager.rs:155-166 clones the channel).
+  std::unique_ptr<RpcClient> client;
+  while (running_.load()) {
+    try {
+      if (!client)
+        client = std::make_unique<RpcClient>(lighthouse_addr_, 5000);
+      client->call("lh.heartbeat",
+                   Value::M().set("replica_id", Value::S(replica_id_)), 5000);
+    } catch (const std::exception&) {
+      client.reset();  // reconnect next round
+    }
+    int64_t slept = 0;
+    while (running_.load() && slept < heartbeat_interval_ms_) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+      slept += 10;
+    }
+  }
+}
+
+Value ManagerSrv::handle_rpc(const std::string& method, const Value& req,
+                             int64_t deadline) {
+  if (method == "mgr.quorum") return handle_quorum(req, deadline);
+  if (method == "mgr.should_commit") return handle_should_commit(req, deadline);
+  if (method == "mgr.checkpoint_metadata") {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = checkpoint_metadata_.find(req.geti("rank"));
+    if (it == checkpoint_metadata_.end())
+      throw RpcError(INVALID_ARGUMENT, "rank not found");
+    return Value::M().set("checkpoint_metadata", Value::S(it->second));
+  }
+  if (method == "mgr.kill") {
+    logline("got kill request: " + req.gets("msg"));
+    if (getenv("TORCHFT_TPU_SOFT_KILL") == nullptr) {
+      fflush(nullptr);
+      _exit(1);
+    }
+    return Value::M();  // soft kill for in-process tests
+  }
+  throw RpcError(INVALID_ARGUMENT, "unknown method " + method);
+}
+
+Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
+  int64_t rank = req.geti("rank");
+  int64_t step = req.geti("step");
+  int64_t timeout_ms = req.geti("_d", 60000);
+
+  std::unique_lock<std::mutex> lk(mu_);
+  checkpoint_metadata_[rank] = req.gets("checkpoint_metadata");
+  participants_.insert(rank);
+  uint64_t seen = quorum_seq_;
+
+  if (participants_.size() >= world_size_) {
+    participants_.clear();
+    logline("Manager " + replica_id_ + ": all workers joined, starting quorum");
+    QuorumMember me;
+    me.replica_id = replica_id_;
+    me.address = address();
+    me.store_address = store_address_;
+    me.step = step;
+    me.world_size = world_size_;
+    me.shrink_only = req.getb("shrink_only");
+    Value lreq = Value::M();
+    lreq.set("requester", me.to_value());
+    // Like the reference (src/manager.rs:181 TODO), the lock is held for the
+    // duration of the lighthouse call; peer handlers are parked in cv waits.
+    try {
+      Value resp = lighthouse_client_->call("lh.quorum", lreq, timeout_ms);
+      Quorum q = Quorum::from_value(resp.at("quorum"));
+      quorums_[++quorum_seq_] = q;
+      quorum_error_.reset();
+      while (quorums_.size() > 16) quorums_.erase(quorums_.begin());
+    } catch (const RpcError& e) {
+      // Fan the failure out to all waiting local ranks (the reference only
+      // surfaces it on the triggering rank and lets peers hit their own
+      // deadline; propagating is strictly more informative).
+      quorum_error_ = std::string(e.what());
+      quorum_seq_++;
+    }
+    cv_.notify_all();
+  }
+
+  bool ok = cv_.wait_until(lk,
+                           std::chrono::steady_clock::time_point(
+                               std::chrono::milliseconds(deadline)),
+                           [&] { return quorum_seq_ > seen || !running_.load(); });
+  if (!running_.load()) throw RpcError(CANCELLED, "manager shutting down");
+  if (!ok) throw RpcError(DEADLINE_EXCEEDED, "quorum wait timed out");
+
+  // Take the first quorum delivered after we joined.
+  uint64_t mine = seen + 1;
+  auto it = quorums_.find(mine);
+  if (it == quorums_.end()) {
+    if (quorum_error_.has_value())
+      throw RpcError(CANCELLED, "lighthouse quorum failed: " + *quorum_error_);
+    // trimmed — take oldest retained
+    it = quorums_.begin();
+    if (it == quorums_.end())
+      throw RpcError(INTERNAL, "quorum lost");
+  }
+  ManagerQuorumResult res = compute_quorum_results(replica_id_, rank, it->second);
+  return res.to_value();
+}
+
+Value ManagerSrv::handle_should_commit(const Value& req, int64_t deadline) {
+  int64_t rank = req.geti("rank");
+  bool vote = req.getb("should_commit");
+
+  std::unique_lock<std::mutex> lk(mu_);
+  if (!vote) commit_failures_.insert(rank);
+  commit_votes_.insert(rank);
+  uint64_t seen = commit_seq_;
+
+  if (commit_votes_.size() >= world_size_) {
+    bool decision = commit_failures_.empty();
+    logline("should_commit completed decision=" +
+            std::string(decision ? "true" : "false"));
+    commit_decisions_[++commit_seq_] = decision;
+    while (commit_decisions_.size() > 16)
+      commit_decisions_.erase(commit_decisions_.begin());
+    commit_votes_.clear();
+    commit_failures_.clear();
+    cv_.notify_all();
+  }
+
+  bool ok = cv_.wait_until(lk,
+                           std::chrono::steady_clock::time_point(
+                               std::chrono::milliseconds(deadline)),
+                           [&] { return commit_seq_ > seen || !running_.load(); });
+  if (!running_.load()) throw RpcError(CANCELLED, "manager shutting down");
+  if (!ok) throw RpcError(DEADLINE_EXCEEDED, "should_commit wait timed out");
+
+  auto it = commit_decisions_.find(seen + 1);
+  if (it == commit_decisions_.end()) it = commit_decisions_.begin();
+  if (it == commit_decisions_.end())
+    throw RpcError(INTERNAL, "commit decision lost");
+  return Value::M().set("should_commit", Value::B(it->second));
+}
+
+// ---- KV store -------------------------------------------------------------
+
+KvStore::KvStore(const std::string& bind) : hostname_(get_hostname()) {
+  std::string err;
+  bool ok = server_.start(
+      bind,
+      [this](const std::string& m, const Value& r, int64_t d) {
+        return handle_rpc(m, r, d);
+      },
+      nullptr, &err);
+  if (!ok) throw RpcError(UNAVAILABLE, "store bind failed: " + err);
+}
+
+KvStore::~KvStore() { shutdown(); }
+
+void KvStore::shutdown() {
+  if (!running_.exchange(false)) return;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    cv_.notify_all();
+  }
+  server_.shutdown();
+}
+
+std::string KvStore::address() const {
+  return hostname_ + ":" + std::to_string(server_.port());
+}
+
+Value KvStore::handle_rpc(const std::string& method, const Value& req,
+                          int64_t deadline) {
+  if (method == "store.set") {
+    std::lock_guard<std::mutex> g(mu_);
+    data_[req.gets("k")] = req.gets("v");
+    cv_.notify_all();
+    return Value::M();
+  }
+  if (method == "store.get") {
+    std::unique_lock<std::mutex> lk(mu_);
+    const std::string k = req.gets("k");
+    bool wait = req.getb("wait", true);
+    if (wait) {
+      bool ok = cv_.wait_until(lk,
+                               std::chrono::steady_clock::time_point(
+                                   std::chrono::milliseconds(deadline)),
+                               [&] { return data_.count(k) > 0 || !running_.load(); });
+      if (!ok || !data_.count(k))
+        throw RpcError(DEADLINE_EXCEEDED, "store.get timed out waiting for " + k);
+    } else if (!data_.count(k)) {
+      throw RpcError(NOT_FOUND, "key not found: " + k);
+    }
+    return Value::M().set("v", Value::Bytes(data_[k]));
+  }
+  if (method == "store.add") {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t v = (counters_[req.gets("k")] += req.geti("delta", 1));
+    cv_.notify_all();
+    return Value::M().set("v", Value::I(v));
+  }
+  if (method == "store.del") {
+    std::lock_guard<std::mutex> g(mu_);
+    data_.erase(req.gets("k"));
+    return Value::M();
+  }
+  if (method == "store.keys") {
+    std::lock_guard<std::mutex> g(mu_);
+    const std::string pre = req.gets("prefix");
+    Value out = Value::L();
+    for (const auto& [k, v] : data_)
+      if (k.rfind(pre, 0) == 0) out.list.push_back(Value::S(k));
+    return Value::M().set("keys", out);
+  }
+  throw RpcError(INVALID_ARGUMENT, "unknown method " + method);
+}
+
+}  // namespace tft

@@ -1,0 +1,222 @@
+// torchft_tpu native core — coordination types + pure quorum logic +
+// Lighthouse / Manager servers.
+//
+// C++ re-implementation of the reference's Rust coordination core:
+//   * Lighthouse  — global quorum over replica groups
+//     (/root/reference/src/lighthouse.rs)
+//   * Manager     — per-replica-group rank arbiter
+//     (/root/reference/src/manager.rs)
+// The two decision procedures (quorum_compute, compute_quorum_results) are
+// pure functions over value types, exactly as in the reference, so they are
+// unit-testable without any sockets.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "rpc.h"
+#include "wire.h"
+
+namespace tft {
+
+// ---- wire-level data types (proto/torchft.proto analogues) ---------------
+
+// proto QuorumMember (torchft.proto:38-45)
+struct QuorumMember {
+  std::string replica_id;
+  std::string address;        // manager RPC address
+  std::string store_address;  // replica group's KV store address
+  int64_t step = 0;
+  uint64_t world_size = 0;
+  bool shrink_only = false;
+
+  Value to_value() const;
+  static QuorumMember from_value(const Value& v);
+};
+
+// proto Quorum (torchft.proto:47-51)
+struct Quorum {
+  int64_t quorum_id = 0;
+  std::vector<QuorumMember> participants;
+  int64_t created_unix_ms = 0;
+
+  Value to_value() const;
+  static Quorum from_value(const Value& v);
+};
+
+// proto ManagerQuorumResponse (torchft.proto:79-93)
+struct ManagerQuorumResult {
+  int64_t quorum_id = 0;
+  std::string recover_src_manager_address;
+  std::optional<int64_t> recover_src_rank;
+  std::vector<int64_t> recover_dst_ranks;
+  std::string store_address;
+  int64_t max_step = 0;
+  std::optional<int64_t> max_rank;
+  int64_t max_world_size = 0;
+  int64_t replica_rank = 0;
+  int64_t replica_world_size = 0;
+  bool heal = false;
+
+  Value to_value() const;
+};
+
+// ---- pure decision procedures --------------------------------------------
+
+struct LighthouseOpt {
+  uint64_t min_replicas = 1;
+  uint64_t join_timeout_ms = 60000;
+  uint64_t quorum_tick_ms = 100;
+  uint64_t heartbeat_timeout_ms = 5000;
+};
+
+struct MemberDetails {
+  int64_t joined_ms = 0;  // monotonic timestamp of quorum join
+  QuorumMember member;
+};
+
+struct LighthouseState {
+  std::map<std::string, MemberDetails> participants;
+  std::map<std::string, int64_t> heartbeats;  // replica_id -> last beat (ms)
+  std::optional<Quorum> prev_quorum;
+  int64_t quorum_id = 0;
+};
+
+// Returns (members or nullopt, human-readable reason).
+// Mirrors quorum_compute (src/lighthouse.rs:113-241): healthy-filter by
+// heartbeat age, shrink_only candidate filtering, fast quorum when all prev
+// members are healthy participants, min_replicas floor, split-brain guard
+// (participants must exceed half the heartbeating set), join-timeout
+// straggler wait.
+std::pair<std::optional<std::vector<QuorumMember>>, std::string> quorum_compute(
+    int64_t now_ms, const LighthouseState& state, const LighthouseOpt& opt);
+
+// Mirrors compute_quorum_results (src/manager.rs:357-480): sort by
+// replica_id; max-step cohort; primary store selection rank % cohort;
+// recover_dst = behind-or-(step0-non-primary); round-robin source
+// assignment offset by local rank.
+// Throws RpcError(NOT_FOUND) if replica_id is absent from the quorum.
+ManagerQuorumResult compute_quorum_results(const std::string& replica_id,
+                                           int64_t rank, const Quorum& quorum);
+
+// ---- Lighthouse server ----------------------------------------------------
+
+class Lighthouse {
+ public:
+  Lighthouse(const std::string& bind, const LighthouseOpt& opt);
+  ~Lighthouse();
+  void shutdown();
+
+  std::string address() const;
+  int port() const { return server_.port(); }
+
+ private:
+  friend class LighthouseTestPeer;
+  Value handle_rpc(const std::string& method, const Value& req,
+                   int64_t deadline);
+  Value handle_quorum(const Value& req, int64_t deadline);
+  std::string handle_http(const std::string& method, const std::string& path);
+  void tick_loop();
+  // Must hold mu_. Runs one quorum evaluation and publishes if met.
+  void quorum_tick();
+  std::string status_html();
+  static std::string http_error_page(const std::string& msg);
+
+  LighthouseOpt opt_;
+  RpcServer server_;
+  std::string hostname_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  LighthouseState state_;
+  uint64_t quorum_seq_ = 0;          // bumps every published quorum
+  std::map<uint64_t, Quorum> published_;  // seq -> quorum (last few kept)
+  std::string last_reason_;
+
+  std::atomic<bool> running_{true};
+  std::thread tick_thread_;
+};
+
+// ---- Manager server --------------------------------------------------------
+
+class ManagerSrv {
+ public:
+  ManagerSrv(const std::string& replica_id, const std::string& lighthouse_addr,
+             const std::string& hostname, const std::string& bind,
+             const std::string& store_addr, uint64_t world_size,
+             int64_t heartbeat_interval_ms, int64_t connect_timeout_ms);
+  ~ManagerSrv();
+  void shutdown();
+
+  std::string address() const;
+  int port() const { return server_.port(); }
+
+ private:
+  Value handle_rpc(const std::string& method, const Value& req,
+                   int64_t deadline);
+  Value handle_quorum(const Value& req, int64_t deadline);
+  Value handle_should_commit(const Value& req, int64_t deadline);
+  void heartbeat_loop();
+
+  std::string replica_id_;
+  std::string hostname_;
+  std::string store_address_;
+  std::string lighthouse_addr_;
+  uint64_t world_size_;
+  int64_t heartbeat_interval_ms_;
+  int64_t connect_timeout_ms_;
+
+  RpcServer server_;
+  std::unique_ptr<RpcClient> lighthouse_client_;  // for quorum calls
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<int64_t, std::string> checkpoint_metadata_;
+  std::set<int64_t> participants_;
+  uint64_t quorum_seq_ = 0;
+  std::map<uint64_t, Quorum> quorums_;  // seq -> delivered quorum
+  std::optional<std::string> quorum_error_;  // lighthouse failure fan-out
+
+  std::set<int64_t> commit_votes_;
+  std::set<int64_t> commit_failures_;
+  uint64_t commit_seq_ = 0;
+  std::map<uint64_t, bool> commit_decisions_;
+
+  std::atomic<bool> running_{true};
+  std::thread heartbeat_thread_;
+};
+
+// ---- KV store (TCPStore analogue) -----------------------------------------
+
+class KvStore {
+ public:
+  explicit KvStore(const std::string& bind);
+  ~KvStore();
+  void shutdown();
+  std::string address() const;
+  int port() const { return server_.port(); }
+
+ private:
+  Value handle_rpc(const std::string& method, const Value& req,
+                   int64_t deadline);
+
+  RpcServer server_;
+  std::string hostname_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+  std::map<std::string, int64_t> counters_;
+  std::atomic<bool> running_{true};
+};
+
+std::string get_hostname();
+
+}  // namespace tft

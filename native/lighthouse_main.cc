@@ -1,0 +1,65 @@
+// Standalone lighthouse CLI — the torchft_lighthouse binary analogue
+// (/root/reference/src/bin/lighthouse.rs:10-23). Flags mirror LighthouseOpt
+// (src/lighthouse.rs:66-103) including defaults.
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "coord.h"
+
+static void usage() {
+  fprintf(stderr,
+          "usage: tft_lighthouse --min_replicas N [--bind [::]:29510]\n"
+          "  [--join_timeout_ms 60000] [--quorum_tick_ms 100]\n"
+          "  [--heartbeat_timeout_ms 5000]\n");
+  exit(2);
+}
+
+int main(int argc, char** argv) {
+  std::string bind = "[::]:29510";
+  tft::LighthouseOpt opt;
+  bool have_min = false;
+  for (int i = 1; i < argc; i++) {
+    auto need = [&](const char* flag) -> const char* {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "%s requires a value\n", flag);
+        usage();
+      }
+      return argv[++i];
+    };
+    if (!strcmp(argv[i], "--bind"))
+      bind = need("--bind");
+    else if (!strcmp(argv[i], "--min_replicas")) {
+      opt.min_replicas = strtoull(need("--min_replicas"), nullptr, 10);
+      have_min = true;
+    } else if (!strcmp(argv[i], "--join_timeout_ms"))
+      opt.join_timeout_ms = strtoull(need("--join_timeout_ms"), nullptr, 10);
+    else if (!strcmp(argv[i], "--quorum_tick_ms"))
+      opt.quorum_tick_ms = strtoull(need("--quorum_tick_ms"), nullptr, 10);
+    else if (!strcmp(argv[i], "--heartbeat_timeout_ms"))
+      opt.heartbeat_timeout_ms =
+          strtoull(need("--heartbeat_timeout_ms"), nullptr, 10);
+    else
+      usage();
+  }
+  if (!have_min) usage();
+
+  try {
+    tft::Lighthouse lh(bind, opt);
+    // Run until killed.
+    sigset_t set;
+    sigemptyset(&set);
+    sigaddset(&set, SIGINT);
+    sigaddset(&set, SIGTERM);
+    int sig = 0;
+    sigprocmask(SIG_BLOCK, &set, nullptr);
+    sigwait(&set, &sig);
+    lh.shutdown();
+  } catch (const std::exception& e) {
+    fprintf(stderr, "lighthouse failed: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

@@ -1,0 +1,114 @@
+// torchft_tpu native core — framed RPC server/client over TCP.
+//
+// Replaces the reference's tonic gRPC stack (/root/reference/src/net.rs,
+// src/retry.rs, src/timeout.rs) with a dependency-free equivalent:
+//   * thread-per-connection server that also answers plain HTTP on the same
+//     port (the reference merges axum HTTP + tonic gRPC on one listener,
+//     src/lighthouse.rs:320-358),
+//   * client with exponential-backoff connect retries (retry.rs:6-41) and
+//     TCP keepalives (net.rs:8-20),
+//   * per-request deadline carried in-band ("_d" ms field — the grpc-timeout
+//     header analogue, src/timeout.rs:18-61) and enforced on both sides.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <functional>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+
+#include "wire.h"
+
+namespace tft {
+
+int64_t now_ms();  // monotonic clock, milliseconds
+
+// ---- low-level socket helpers -------------------------------------------
+// fd < 0 on failure. host may be a hostname, IPv4/IPv6 literal, or empty
+// (bind: all interfaces).
+int tcp_listen(const std::string& bind_addr, std::string* err);
+int tcp_connect(const std::string& host, int port, int64_t timeout_ms,
+                std::string* err);
+int listen_port(int fd);
+bool read_exact(int fd, void* buf, size_t n, int64_t deadline_ms);
+bool write_all(int fd, const void* buf, size_t n);
+
+// Parse "http://host:port", "tft://host:port", or "host:port".
+bool parse_addr(const std::string& addr, std::string* host, int* port);
+
+// ---- server --------------------------------------------------------------
+
+// Handler: gets the decoded request MAP (with "_m" method and "_d" deadline
+// in ms already interpreted into deadline: absolute now_ms()+_d). Returns the
+// response body; throws RpcError to return a non-OK status.
+using RpcHandler =
+    std::function<Value(const std::string& method, const Value& req,
+                        int64_t deadline_ms_abs)>;
+
+// HTTP handler: request line + headers already consumed; returns full HTTP
+// response bytes. method is "GET"/"POST", path like "/status".
+using HttpHandler =
+    std::function<std::string(const std::string& method, const std::string& path)>;
+
+class RpcServer {
+ public:
+  RpcServer() = default;
+  ~RpcServer() { shutdown(); }
+
+  // Starts listening + accept thread. Returns false and sets err on failure.
+  bool start(const std::string& bind_addr, RpcHandler handler,
+             HttpHandler http_handler, std::string* err);
+  void shutdown();
+  int port() const { return port_; }
+  bool running() const { return running_.load(); }
+
+ private:
+  void accept_loop();
+  void serve_conn(int fd);
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  RpcHandler handler_;
+  HttpHandler http_handler_;
+
+  std::mutex conns_mu_;
+  std::set<int> conns_;
+  std::atomic<int> active_conns_{0};
+};
+
+// ---- client --------------------------------------------------------------
+
+class RpcClient {
+ public:
+  // Connects eagerly, retrying with exponential backoff until
+  // connect_timeout_ms elapses (parity with the reference's retrying
+  // connect, src/net.rs:22-34). Throws RpcError(UNAVAILABLE) on failure.
+  RpcClient(const std::string& addr, int64_t connect_timeout_ms);
+  ~RpcClient();
+
+  RpcClient(const RpcClient&) = delete;
+  RpcClient& operator=(const RpcClient&) = delete;
+
+  // Sends {._m=method, ._d=timeout_ms, ...req} and waits for the response.
+  // Throws RpcError on transport failure / deadline / non-OK status.
+  Value call(const std::string& method, Value req, int64_t timeout_ms);
+
+  const std::string& addr() const { return addr_; }
+
+ private:
+  void ensure_connected(int64_t timeout_ms);
+  void disconnect();
+
+  std::string addr_;
+  std::string host_;
+  int port_ = 0;
+  int64_t connect_timeout_ms_;
+  std::mutex mu_;
+  int fd_ = -1;
+};
+
+}  // namespace tft

@@ -1,0 +1,303 @@
+// torchft_tpu native core — wire codec.
+//
+// A compact, dependency-free binary encoding shared between the C++
+// coordination core and the Python client (torchft_tpu/utils/wire.py).
+// Plays the role of protobuf in the reference (/root/reference/proto/
+// torchft.proto) — same message *semantics*, different encoding, since this
+// image ships no gRPC/protobuf dev headers and the control-plane traffic is
+// tiny (a few hundred bytes per step).
+//
+// Encoding (all integers little-endian):
+//   value   := tag(u8) payload
+//   tag     := 1 I64 | 2 F64 | 3 BOOL | 4 STR | 5 BYTES | 6 LIST | 7 MAP | 8 NONE
+//   I64/F64 := 8 bytes
+//   BOOL    := 1 byte
+//   STR     := u32 len + utf-8 bytes      BYTES := u32 len + bytes
+//   LIST    := u32 count + count values
+//   MAP     := u32 count + count * (u16 keylen + key + value)
+//
+// RPC framing (rpc.h): 4-byte magic "TFT1" once per connection, then
+// u32-length-prefixed frames, each a MAP value.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace tft {
+
+struct WireError : std::runtime_error {
+  explicit WireError(const std::string& m) : std::runtime_error(m) {}
+};
+
+struct Value {
+  enum class Type : uint8_t {
+    I64 = 1,
+    F64 = 2,
+    BOOL = 3,
+    STR = 4,
+    BYTES = 5,
+    LIST = 6,
+    MAP = 7,
+    NONE = 8,
+  };
+
+  Type type = Type::NONE;
+  int64_t i = 0;
+  double f = 0.0;
+  bool b = false;
+  std::string s;  // STR and BYTES
+  std::vector<Value> list;
+  std::map<std::string, Value> map;
+
+  Value() = default;
+
+  static Value I(int64_t v) {
+    Value x;
+    x.type = Type::I64;
+    x.i = v;
+    return x;
+  }
+  static Value F(double v) {
+    Value x;
+    x.type = Type::F64;
+    x.f = v;
+    return x;
+  }
+  static Value B(bool v) {
+    Value x;
+    x.type = Type::BOOL;
+    x.b = v;
+    return x;
+  }
+  static Value S(std::string v) {
+    Value x;
+    x.type = Type::STR;
+    x.s = std::move(v);
+    return x;
+  }
+  static Value Bytes(std::string v) {
+    Value x;
+    x.type = Type::BYTES;
+    x.s = std::move(v);
+    return x;
+  }
+  static Value L(std::vector<Value> v = {}) {
+    Value x;
+    x.type = Type::LIST;
+    x.list = std::move(v);
+    return x;
+  }
+  static Value M() {
+    Value x;
+    x.type = Type::MAP;
+    return x;
+  }
+  static Value None() { return Value(); }
+
+  bool is_none() const { return type == Type::NONE; }
+
+  bool has(const std::string& k) const {
+    return type == Type::MAP && map.count(k) > 0;
+  }
+  const Value& at(const std::string& k) const {
+    auto it = map.find(k);
+    if (it == map.end()) throw WireError("missing field: " + k);
+    return it->second;
+  }
+  // Accessors with defaults for optional fields.
+  int64_t geti(const std::string& k, int64_t d = 0) const {
+    auto it = map.find(k);
+    return it == map.end() || it->second.is_none() ? d : it->second.i;
+  }
+  bool getb(const std::string& k, bool d = false) const {
+    auto it = map.find(k);
+    return it == map.end() || it->second.is_none() ? d : it->second.b;
+  }
+  std::string gets(const std::string& k, const std::string& d = "") const {
+    auto it = map.find(k);
+    return it == map.end() || it->second.is_none() ? d : it->second.s;
+  }
+  Value& set(const std::string& k, Value v) {
+    map[k] = std::move(v);
+    return *this;
+  }
+};
+
+namespace detail {
+
+inline void put_u8(std::string& out, uint8_t v) { out.push_back((char)v); }
+inline void put_u16(std::string& out, uint16_t v) {
+  out.push_back((char)(v & 0xff));
+  out.push_back((char)(v >> 8));
+}
+inline void put_u32(std::string& out, uint32_t v) {
+  for (int k = 0; k < 4; k++) out.push_back((char)((v >> (8 * k)) & 0xff));
+}
+inline void put_u64(std::string& out, uint64_t v) {
+  for (int k = 0; k < 8; k++) out.push_back((char)((v >> (8 * k)) & 0xff));
+}
+
+struct Reader {
+  const uint8_t* p;
+  size_t n;
+  size_t off = 0;
+
+  void need(size_t k) const {
+    if (off + k > n) throw WireError("truncated message");
+  }
+  uint8_t u8() {
+    need(1);
+    return p[off++];
+  }
+  uint16_t u16() {
+    need(2);
+    uint16_t v = (uint16_t)p[off] | ((uint16_t)p[off + 1] << 8);
+    off += 2;
+    return v;
+  }
+  uint32_t u32() {
+    need(4);
+    uint32_t v = 0;
+    for (int k = 0; k < 4; k++) v |= (uint32_t)p[off + k] << (8 * k);
+    off += 4;
+    return v;
+  }
+  uint64_t u64() {
+    need(8);
+    uint64_t v = 0;
+    for (int k = 0; k < 8; k++) v |= (uint64_t)p[off + k] << (8 * k);
+    off += 8;
+    return v;
+  }
+  std::string str(size_t len) {
+    need(len);
+    std::string s((const char*)p + off, len);
+    off += len;
+    return s;
+  }
+};
+
+}  // namespace detail
+
+inline void encode(const Value& v, std::string& out) {
+  using detail::put_u16;
+  using detail::put_u32;
+  using detail::put_u64;
+  using detail::put_u8;
+  put_u8(out, (uint8_t)v.type);
+  switch (v.type) {
+    case Value::Type::I64:
+      put_u64(out, (uint64_t)v.i);
+      break;
+    case Value::Type::F64: {
+      uint64_t bits;
+      std::memcpy(&bits, &v.f, 8);
+      put_u64(out, bits);
+      break;
+    }
+    case Value::Type::BOOL:
+      put_u8(out, v.b ? 1 : 0);
+      break;
+    case Value::Type::STR:
+    case Value::Type::BYTES:
+      put_u32(out, (uint32_t)v.s.size());
+      out.append(v.s);
+      break;
+    case Value::Type::LIST:
+      put_u32(out, (uint32_t)v.list.size());
+      for (const auto& e : v.list) encode(e, out);
+      break;
+    case Value::Type::MAP:
+      put_u32(out, (uint32_t)v.map.size());
+      for (const auto& kv : v.map) {
+        put_u16(out, (uint16_t)kv.first.size());
+        out.append(kv.first);
+        encode(kv.second, out);
+      }
+      break;
+    case Value::Type::NONE:
+      break;
+  }
+}
+
+inline std::string encode(const Value& v) {
+  std::string out;
+  encode(v, out);
+  return out;
+}
+
+inline Value decode_one(detail::Reader& r, int depth = 0) {
+  if (depth > 64) throw WireError("nesting too deep");
+  Value v;
+  uint8_t tag = r.u8();
+  v.type = (Value::Type)tag;
+  switch (v.type) {
+    case Value::Type::I64:
+      v.i = (int64_t)r.u64();
+      break;
+    case Value::Type::F64: {
+      uint64_t bits = r.u64();
+      std::memcpy(&v.f, &bits, 8);
+      break;
+    }
+    case Value::Type::BOOL:
+      v.b = r.u8() != 0;
+      break;
+    case Value::Type::STR:
+    case Value::Type::BYTES:
+      v.s = r.str(r.u32());
+      break;
+    case Value::Type::LIST: {
+      uint32_t n = r.u32();
+      v.list.reserve(n);
+      for (uint32_t k = 0; k < n; k++) v.list.push_back(decode_one(r, depth + 1));
+      break;
+    }
+    case Value::Type::MAP: {
+      uint32_t n = r.u32();
+      for (uint32_t k = 0; k < n; k++) {
+        std::string key = r.str(r.u16());
+        v.map[key] = decode_one(r, depth + 1);
+      }
+      break;
+    }
+    case Value::Type::NONE:
+      break;
+    default:
+      throw WireError("bad tag " + std::to_string(tag));
+  }
+  return v;
+}
+
+inline Value decode(const uint8_t* p, size_t n) {
+  detail::Reader r{p, n};
+  Value v = decode_one(r);
+  return v;
+}
+
+inline Value decode(const std::string& s) {
+  return decode((const uint8_t*)s.data(), s.size());
+}
+
+// RPC status codes (mirrors the subset of gRPC statuses the reference maps
+// to Python exceptions — /root/reference/src/lib.rs:380-398).
+enum Status : int64_t {
+  OK = 0,
+  CANCELLED = 1,
+  INVALID_ARGUMENT = 2,
+  NOT_FOUND = 3,
+  DEADLINE_EXCEEDED = 4,
+  INTERNAL = 5,
+  UNAVAILABLE = 6,
+};
+
+struct RpcError : std::runtime_error {
+  Status code;
+  RpcError(Status c, const std::string& m) : std::runtime_error(m), code(c) {}
+};
+
+}  // namespace tft

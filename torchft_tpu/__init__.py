@@ -1,0 +1,11 @@
+"""torchft_tpu — TPU-native per-step fault tolerance for replicated training.
+
+A ground-up JAX/XLA re-design of the capabilities of torchft
+(Krishn1412/torchft): dynamic quorums over replica groups (C++ Lighthouse),
+per-group rank arbitration (C++ Manager), reconfigurable collectives, live
+peer-to-peer checkpoint recovery, and training-loop wrappers (gradient
+averaging, optimizer commit gating, LocalSGD/DiLoCo) — built on
+pjit/shard_map meshes rather than NCCL process groups.
+"""
+
+__version__ = "0.1.0"

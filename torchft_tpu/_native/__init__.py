@@ -1,0 +1,265 @@
+"""ctypes loader for the C++ coordination core (``native/``).
+
+The reference binds its Rust core with pyo3 (/root/reference/src/lib.rs);
+here the equivalent bridge is a C ABI + ctypes. If the shared library is
+missing (fresh checkout), it is built on first import with ``make``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Any, Dict, Tuple
+
+from torchft_tpu.utils import wire
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libtftcore.so")
+_NATIVE_SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "native"))
+
+# RPC status codes (native/wire.h). CANCELLED and DEADLINE_EXCEEDED map to
+# TimeoutError, everything else to RuntimeError — parity with the reference's
+# Status -> PyErr mapping (src/lib.rs:380-398).
+OK = 0
+CANCELLED = 1
+INVALID_ARGUMENT = 2
+NOT_FOUND = 3
+DEADLINE_EXCEEDED = 4
+INTERNAL = 5
+UNAVAILABLE = 6
+
+_TIMEOUT_CODES = (CANCELLED, DEADLINE_EXCEEDED)
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-s"],
+        cwd=_NATIVE_SRC,
+        check=True,
+        capture_output=True,
+    )
+
+
+def _load() -> ctypes.CDLL:
+    if not os.path.exists(_LIB_PATH):
+        _build()
+    lib = ctypes.CDLL(_LIB_PATH)
+
+    c = ctypes
+    u8p = c.POINTER(c.c_uint8)
+
+    lib.tft_buf_free.argtypes = [u8p]
+    lib.tft_buf_free.restype = None
+
+    lib.tft_lighthouse_create.argtypes = [
+        c.c_char_p, c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint64,
+        c.c_char_p, c.c_int,
+    ]
+    lib.tft_lighthouse_create.restype = c.c_int64
+    lib.tft_lighthouse_address.argtypes = [c.c_int64, c.c_char_p, c.c_int]
+    lib.tft_lighthouse_address.restype = None
+    lib.tft_lighthouse_shutdown.argtypes = [c.c_int64]
+    lib.tft_lighthouse_shutdown.restype = None
+
+    lib.tft_manager_create.argtypes = [
+        c.c_char_p, c.c_char_p, c.c_char_p, c.c_char_p, c.c_char_p,
+        c.c_uint64, c.c_int64, c.c_int64, c.c_char_p, c.c_int,
+    ]
+    lib.tft_manager_create.restype = c.c_int64
+    lib.tft_manager_address.argtypes = [c.c_int64, c.c_char_p, c.c_int]
+    lib.tft_manager_address.restype = None
+    lib.tft_manager_shutdown.argtypes = [c.c_int64]
+    lib.tft_manager_shutdown.restype = None
+
+    lib.tft_store_create.argtypes = [c.c_char_p, c.c_char_p, c.c_int]
+    lib.tft_store_create.restype = c.c_int64
+    lib.tft_store_address.argtypes = [c.c_int64, c.c_char_p, c.c_int]
+    lib.tft_store_address.restype = None
+    lib.tft_store_shutdown.argtypes = [c.c_int64]
+    lib.tft_store_shutdown.restype = None
+
+    lib.tft_client_create.argtypes = [c.c_char_p, c.c_int64, c.c_char_p, c.c_int]
+    lib.tft_client_create.restype = c.c_int64
+    lib.tft_client_call.argtypes = [
+        c.c_int64, c.c_char_p, u8p, c.c_int64, c.c_int64,
+        c.POINTER(u8p), c.POINTER(c.c_int64), c.c_char_p, c.c_int,
+    ]
+    lib.tft_client_call.restype = c.c_int64
+    lib.tft_client_free.argtypes = [c.c_int64]
+    lib.tft_client_free.restype = None
+
+    lib.tft_quorum_compute.argtypes = [
+        u8p, c.c_int64, c.POINTER(u8p), c.POINTER(c.c_int64), c.c_char_p, c.c_int,
+    ]
+    lib.tft_quorum_compute.restype = c.c_int64
+    lib.tft_compute_quorum_results.argtypes = [
+        u8p, c.c_int64, c.c_char_p, c.c_int64,
+        c.POINTER(u8p), c.POINTER(c.c_int64), c.c_char_p, c.c_int,
+    ]
+    lib.tft_compute_quorum_results.restype = c.c_int64
+
+    return lib
+
+
+_lib = _load()
+
+_ERRLEN = 1024
+
+
+def _raise_status(code: int, msg: str) -> None:
+    if code in _TIMEOUT_CODES:
+        raise TimeoutError(msg)
+    raise RuntimeError(msg)
+
+
+def _errbuf() -> ctypes.Array:
+    return ctypes.create_string_buffer(_ERRLEN)
+
+
+def _take_out(outp: Any, outlen: Any) -> bytes:
+    try:
+        return ctypes.string_at(outp, outlen.value)
+    finally:
+        _lib.tft_buf_free(outp)
+
+
+class NativeClient:
+    """Generic RPC client over the C++ transport (retry/backoff/keepalive
+    live in native/rpc.cc, parity with src/net.rs + src/retry.rs)."""
+
+    def __init__(self, addr: str, connect_timeout_ms: int) -> None:
+        err = _errbuf()
+        self._h = _lib.tft_client_create(
+            addr.encode(), int(connect_timeout_ms), err, _ERRLEN
+        )
+        if self._h == 0:
+            _raise_status(UNAVAILABLE, err.value.decode())
+        self._addr = addr
+
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    def call(self, method: str, req: Dict[str, Any], timeout_ms: int) -> Dict[str, Any]:
+        buf = wire.encode(req)
+        cbuf = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf) if buf else None
+        outp = ctypes.POINTER(ctypes.c_uint8)()
+        outlen = ctypes.c_int64()
+        err = _errbuf()
+        code = _lib.tft_client_call(
+            self._h, method.encode(), cbuf, len(buf), int(timeout_ms),
+            ctypes.byref(outp), ctypes.byref(outlen), err, _ERRLEN,
+        )
+        if code != OK:
+            _raise_status(code, f"{method}: {err.value.decode()}")
+        return wire.decode(_take_out(outp, outlen))
+
+    def close(self) -> None:
+        if self._h:
+            _lib.tft_client_free(self._h)
+            self._h = 0
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _server_address(getter: Any, h: int) -> str:
+    buf = ctypes.create_string_buffer(512)
+    getter(h, buf, 512)
+    return buf.value.decode()
+
+
+def lighthouse_create(
+    bind: str,
+    min_replicas: int,
+    join_timeout_ms: int,
+    quorum_tick_ms: int,
+    heartbeat_timeout_ms: int,
+) -> Tuple[int, str]:
+    err = _errbuf()
+    h = _lib.tft_lighthouse_create(
+        bind.encode(), min_replicas, join_timeout_ms, quorum_tick_ms,
+        heartbeat_timeout_ms, err, _ERRLEN,
+    )
+    if h == 0:
+        raise RuntimeError(err.value.decode())
+    return h, _server_address(_lib.tft_lighthouse_address, h)
+
+
+def lighthouse_shutdown(h: int) -> None:
+    _lib.tft_lighthouse_shutdown(h)
+
+
+def manager_create(
+    replica_id: str,
+    lighthouse_addr: str,
+    hostname: str,
+    bind: str,
+    store_addr: str,
+    world_size: int,
+    heartbeat_interval_ms: int,
+    connect_timeout_ms: int,
+) -> Tuple[int, str]:
+    err = _errbuf()
+    h = _lib.tft_manager_create(
+        replica_id.encode(), lighthouse_addr.encode(), hostname.encode(),
+        bind.encode(), store_addr.encode(), world_size,
+        heartbeat_interval_ms, connect_timeout_ms, err, _ERRLEN,
+    )
+    if h == 0:
+        msg = err.value.decode()
+        if "timed out" in msg:
+            raise TimeoutError(msg)
+        raise RuntimeError(msg)
+    return h, _server_address(_lib.tft_manager_address, h)
+
+
+def manager_shutdown(h: int) -> None:
+    _lib.tft_manager_shutdown(h)
+
+
+def store_create(bind: str) -> Tuple[int, str]:
+    err = _errbuf()
+    h = _lib.tft_store_create(bind.encode(), err, _ERRLEN)
+    if h == 0:
+        raise RuntimeError(err.value.decode())
+    return h, _server_address(_lib.tft_store_address, h)
+
+
+def store_shutdown(h: int) -> None:
+    _lib.tft_store_shutdown(h)
+
+
+def _pure_call(fn: Any, buf: bytes, *extra: Any) -> Dict[str, Any]:
+    cbuf = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+    outp = ctypes.POINTER(ctypes.c_uint8)()
+    outlen = ctypes.c_int64()
+    err = _errbuf()
+    code = fn(cbuf, len(buf), *extra, ctypes.byref(outp), ctypes.byref(outlen),
+              err, _ERRLEN)
+    if code != OK:
+        _raise_status(code, err.value.decode())
+    return wire.decode(_take_out(outp, outlen))
+
+
+def quorum_compute(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Run the C++ quorum_compute pure function on an explicit state.
+
+    For unit tests (parity with src/lighthouse.rs:582-1001 table tests)."""
+    return _pure_call(_lib.tft_quorum_compute, wire.encode(state))
+
+
+def compute_quorum_results(
+    quorum: Dict[str, Any], replica_id: str, rank: int
+) -> Dict[str, Any]:
+    """Run the C++ compute_quorum_results pure function.
+
+    For unit tests (parity with src/manager.rs:720-850 table tests)."""
+    return _pure_call(
+        _lib.tft_compute_quorum_results, wire.encode(quorum),
+        replica_id.encode(), rank,
+    )

@@ -1,0 +1,222 @@
+"""Low-level coordination API: Lighthouse / Manager servers and clients.
+
+Public surface mirrors the reference's pyo3 module ``torchft._torchft``
+(type stubs at /root/reference/torchft/_torchft.pyi:1-61, re-exported by
+torchft/coordination.py:17-23) — same classes, same methods, same timeout
+semantics (CANCELLED / DEADLINE_EXCEEDED become ``TimeoutError``). The
+servers themselves run in the C++ core (``native/coord.cc``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Any, Dict, List, Optional
+
+from torchft_tpu import _native
+
+__all__ = [
+    "LighthouseServer",
+    "ManagerServer",
+    "ManagerClient",
+    "LighthouseClient",
+    "QuorumResult",
+]
+
+
+def _ms(t: timedelta) -> int:
+    return max(1, int(t.total_seconds() * 1000))
+
+
+@dataclass
+class QuorumResult:
+    """Per-rank quorum outcome (ManagerQuorumResponse analogue,
+    proto/torchft.proto:79-93 / src/lib.rs:240-273)."""
+
+    quorum_id: int = 0
+    replica_rank: int = 0
+    replica_world_size: int = 1
+    recover_src_manager_address: str = ""
+    recover_src_rank: Optional[int] = None
+    recover_dst_ranks: List[int] = field(default_factory=list)
+    store_address: str = ""
+    max_step: int = 0
+    max_rank: Optional[int] = None
+    max_world_size: int = 1
+    heal: bool = False
+
+    @staticmethod
+    def _from_wire(d: Dict[str, Any]) -> "QuorumResult":
+        return QuorumResult(
+            quorum_id=d.get("quorum_id", 0),
+            replica_rank=d.get("replica_rank", 0),
+            replica_world_size=d.get("replica_world_size", 1),
+            recover_src_manager_address=d.get("recover_src_manager_address", ""),
+            recover_src_rank=d.get("recover_src_rank"),
+            recover_dst_ranks=list(d.get("recover_dst_ranks", [])),
+            store_address=d.get("store_address", ""),
+            max_step=d.get("max_step", 0),
+            max_rank=d.get("max_rank"),
+            max_world_size=d.get("max_world_size", 1),
+            heal=d.get("heal", False),
+        )
+
+
+class LighthouseServer:
+    """Global quorum coordinator across replica groups.
+
+    C++ server (native/coord.cc Lighthouse) re-implementing
+    src/lighthouse.rs: heartbeat-based health, fast quorum, split-brain
+    guard, shrink-only membership, join-timeout straggler wait, and an HTTP
+    dashboard on the same port. Defaults match the Python binding defaults
+    (src/lib.rs:339-341): join=100ms, tick=100ms, heartbeat timeout=5s.
+    """
+
+    def __init__(
+        self,
+        bind: str,
+        min_replicas: int,
+        join_timeout_ms: Optional[int] = None,
+        quorum_tick_ms: Optional[int] = None,
+        heartbeat_timeout_ms: Optional[int] = None,
+    ) -> None:
+        self._handle, self._address = _native.lighthouse_create(
+            bind,
+            min_replicas,
+            join_timeout_ms if join_timeout_ms is not None else 100,
+            quorum_tick_ms if quorum_tick_ms is not None else 100,
+            heartbeat_timeout_ms if heartbeat_timeout_ms is not None else 5000,
+        )
+
+    def address(self) -> str:
+        return self._address
+
+    def shutdown(self) -> None:
+        if self._handle:
+            _native.lighthouse_shutdown(self._handle)
+            self._handle = 0
+
+    def __del__(self) -> None:
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+class ManagerServer:
+    """Per-replica-group coordinator (src/manager.rs analogue): aggregates the
+    group's local ranks, proxies quorum to the lighthouse, computes per-rank
+    recovery assignments, and arbitrates the commit vote."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        hostname: str,
+        bind: str,
+        store_addr: str,
+        world_size: int,
+        heartbeat_interval: timedelta = timedelta(milliseconds=100),
+        connect_timeout: timedelta = timedelta(seconds=60),
+    ) -> None:
+        self._handle, self._address = _native.manager_create(
+            replica_id,
+            lighthouse_addr,
+            hostname,
+            bind,
+            store_addr,
+            world_size,
+            _ms(heartbeat_interval),
+            _ms(connect_timeout),
+        )
+
+    def address(self) -> str:
+        return self._address
+
+    def shutdown(self) -> None:
+        if self._handle:
+            _native.manager_shutdown(self._handle)
+            self._handle = 0
+
+    def __del__(self) -> None:
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+class ManagerClient:
+    """Client for a ManagerServer (src/lib.rs:115-238 analogue). Timeouts
+    travel in-band and are enforced server-side (grpc-timeout parity)."""
+
+    def __init__(self, addr: str, connect_timeout: timedelta) -> None:
+        self._client = _native.NativeClient(addr, _ms(connect_timeout))
+
+    def _quorum(
+        self,
+        rank: int,
+        step: int,
+        checkpoint_metadata: str,
+        shrink_only: bool,
+        timeout: timedelta,
+    ) -> QuorumResult:
+        resp = self._client.call(
+            "mgr.quorum",
+            {
+                "rank": rank,
+                "step": step,
+                "checkpoint_metadata": checkpoint_metadata,
+                "shrink_only": shrink_only,
+            },
+            _ms(timeout),
+        )
+        return QuorumResult._from_wire(resp)
+
+    def _checkpoint_metadata(self, rank: int, timeout: timedelta) -> str:
+        resp = self._client.call(
+            "mgr.checkpoint_metadata", {"rank": rank}, _ms(timeout)
+        )
+        return resp["checkpoint_metadata"]
+
+    def should_commit(
+        self,
+        rank: int,
+        step: int,
+        should_commit: bool,
+        timeout: timedelta,
+    ) -> bool:
+        resp = self._client.call(
+            "mgr.should_commit",
+            {"rank": rank, "step": step, "should_commit": should_commit},
+            _ms(timeout),
+        )
+        return resp["should_commit"]
+
+    def kill(self, msg: str = "", timeout: timedelta = timedelta(seconds=10)) -> None:
+        self._client.call("mgr.kill", {"msg": msg}, _ms(timeout))
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class LighthouseClient:
+    """Direct lighthouse client — heartbeat + quorum (LighthouseService
+    analogue). The Manager server normally does this for you; exposed for
+    tests and tooling."""
+
+    def __init__(self, addr: str, connect_timeout: timedelta) -> None:
+        self._client = _native.NativeClient(addr, _ms(connect_timeout))
+
+    def heartbeat(self, replica_id: str, timeout: timedelta = timedelta(seconds=5)) -> None:
+        self._client.call("lh.heartbeat", {"replica_id": replica_id}, _ms(timeout))
+
+    def quorum(
+        self,
+        requester: Dict[str, Any],
+        timeout: timedelta,
+    ) -> Dict[str, Any]:
+        resp = self._client.call("lh.quorum", {"requester": requester}, _ms(timeout))
+        return resp["quorum"]
+
+    def close(self) -> None:
+        self._client.close()
