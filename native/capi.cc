@@ -25,7 +25,9 @@ int64_t g_next = 1;
 std::map<int64_t, std::unique_ptr<Lighthouse>> g_lighthouses;
 std::map<int64_t, std::unique_ptr<ManagerSrv>> g_managers;
 std::map<int64_t, std::unique_ptr<KvStore>> g_stores;
-std::map<int64_t, std::unique_ptr<RpcClient>> g_clients;
+// shared_ptr: a call may be in flight on another thread when the handle is
+// freed; the last owner destroys the client.
+std::map<int64_t, std::shared_ptr<RpcClient>> g_clients;
 
 void set_err(char* err, int errlen, const std::string& msg) {
   if (err && errlen > 0) {
@@ -170,7 +172,7 @@ void tft_store_shutdown(int64_t h) {
 int64_t tft_client_create(const char* addr, int64_t connect_timeout_ms,
                           char* err, int errlen) {
   try {
-    auto c = std::make_unique<RpcClient>(addr, connect_timeout_ms);
+    auto c = std::make_shared<RpcClient>(addr, connect_timeout_ms);
     std::lock_guard<std::mutex> g(g_mu);
     int64_t h = g_next++;
     g_clients[h] = std::move(c);
@@ -187,7 +189,7 @@ int64_t tft_client_create(const char* addr, int64_t connect_timeout_ms,
 int64_t tft_client_call(int64_t h, const char* method, const uint8_t* req,
                         int64_t reqlen, int64_t timeout_ms, uint8_t** out,
                         int64_t* outlen, char* err, int errlen) {
-  RpcClient* c = nullptr;
+  std::shared_ptr<RpcClient> c;
   {
     std::lock_guard<std::mutex> g(g_mu);
     auto it = g_clients.find(h);
@@ -195,7 +197,7 @@ int64_t tft_client_call(int64_t h, const char* method, const uint8_t* req,
       set_err(err, errlen, "bad client handle");
       return INVALID_ARGUMENT;
     }
-    c = it->second.get();
+    c = it->second;
   }
   try {
     Value v = req && reqlen > 0 ? decode(req, (size_t)reqlen) : Value::M();
@@ -213,8 +215,17 @@ int64_t tft_client_call(int64_t h, const char* method, const uint8_t* req,
 }
 
 void tft_client_free(int64_t h) {
-  std::lock_guard<std::mutex> g(g_mu);
-  g_clients.erase(h);
+  std::shared_ptr<RpcClient> c;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_clients.find(h);
+    if (it == g_clients.end()) return;
+    c = std::move(it->second);
+    g_clients.erase(it);
+  }
+  // Unblock any in-flight call; the concurrent caller still holds a
+  // shared_ptr, so destruction happens after its call returns.
+  c->abort();
 }
 
 // ---- pure decision procedures (for unit tests, mirroring the reference's

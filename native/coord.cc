@@ -296,7 +296,12 @@ Lighthouse::~Lighthouse() { shutdown(); }
 
 void Lighthouse::shutdown() {
   if (!running_.exchange(false)) return;
-  cv_.notify_all();
+  {
+    // Hold mu_ while notifying so parked handler waits can't miss the
+    // running_ flip (lost-wakeup window of cv_.wait_until).
+    std::lock_guard<std::mutex> g(mu_);
+    cv_.notify_all();
+  }
   if (tick_thread_.joinable()) tick_thread_.join();
   server_.shutdown();
 }
@@ -551,7 +556,13 @@ ManagerSrv::~ManagerSrv() { shutdown(); }
 
 void ManagerSrv::shutdown() {
   if (!running_.exchange(false)) return;
-  cv_.notify_all();
+  // A handler may be blocked inside the lighthouse long-poll holding mu_;
+  // abort the socket first so it fails fast and releases the lock.
+  lighthouse_client_->abort();
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    cv_.notify_all();
+  }
   if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
   server_.shutdown();
 }
@@ -753,8 +764,16 @@ Value KvStore::handle_rpc(const std::string& method, const Value& req,
     return Value::M().set("v", Value::Bytes(data_[k]));
   }
   if (method == "store.add") {
+    // Counters live in data_ as decimal strings so get/wait/del/keys all
+    // observe them (TCPStore add/get interop semantics).
     std::lock_guard<std::mutex> g(mu_);
-    int64_t v = (counters_[req.gets("k")] += req.geti("delta", 1));
+    const std::string k = req.gets("k");
+    int64_t v = 0;
+    auto it = data_.find(k);
+    if (it != data_.end() && !it->second.empty())
+      v = strtoll(it->second.c_str(), nullptr, 10);
+    v += req.geti("delta", 1);
+    data_[k] = std::to_string(v);
     cv_.notify_all();
     return Value::M().set("v", Value::I(v));
   }

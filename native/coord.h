@@ -213,7 +213,6 @@ class KvStore {
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<std::string, std::string> data_;
-  std::map<std::string, int64_t> counters_;
   std::atomic<bool> running_{true};
 };
 

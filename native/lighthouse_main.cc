@@ -46,15 +46,18 @@ int main(int argc, char** argv) {
   }
   if (!have_min) usage();
 
+  // Block SIGINT/SIGTERM before any server threads spawn so they inherit
+  // the blocked mask and the signals reach sigwait instead of killing a
+  // worker thread.
+  sigset_t set;
+  sigemptyset(&set);
+  sigaddset(&set, SIGINT);
+  sigaddset(&set, SIGTERM);
+  sigprocmask(SIG_BLOCK, &set, nullptr);
+
   try {
     tft::Lighthouse lh(bind, opt);
-    // Run until killed.
-    sigset_t set;
-    sigemptyset(&set);
-    sigaddset(&set, SIGINT);
-    sigaddset(&set, SIGTERM);
     int sig = 0;
-    sigprocmask(SIG_BLOCK, &set, nullptr);
     sigwait(&set, &sig);
     lh.shutdown();
   } catch (const std::exception& e) {

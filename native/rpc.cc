@@ -243,11 +243,16 @@ void RpcServer::shutdown() {
     for (int fd : conns_) ::shutdown(fd, SHUT_RDWR);
   }
   if (accept_thread_.joinable()) accept_thread_.join();
-  // Give connection threads a moment to drain; they are detached and only
-  // touch their own fd after this point.
-  int64_t deadline = now_ms() + 2000;
-  while (active_conns_.load() > 0 && now_ms() < deadline)
-    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  // Join all connection threads. Owners must cancel any in-handler blocking
+  // waits (cv broadcasts, client aborts) *before* calling this so the join
+  // completes promptly; once it returns, no thread touches handler state.
+  std::map<uint64_t, std::thread> threads;
+  {
+    std::lock_guard<std::mutex> g(conns_mu_);
+    threads.swap(conn_threads_);
+  }
+  for (auto& [id, t] : threads)
+    if (t.joinable()) t.join();
 }
 
 void RpcServer::accept_loop() {
@@ -258,20 +263,31 @@ void RpcServer::accept_loop() {
       break;  // listener closed
     }
     set_keepalive(fd);
+    std::vector<std::thread> reaped;
     {
       std::lock_guard<std::mutex> g(conns_mu_);
-      conns_.insert(fd);
-    }
-    active_conns_.fetch_add(1);
-    std::thread([this, fd] {
-      serve_conn(fd);
-      {
-        std::lock_guard<std::mutex> g(conns_mu_);
-        conns_.erase(fd);
+      // Reap threads for connections that already finished (join is
+      // instant once a thread has announced itself in finished_threads_).
+      for (uint64_t id : finished_threads_) {
+        auto it = conn_threads_.find(id);
+        if (it != conn_threads_.end()) {
+          reaped.push_back(std::move(it->second));
+          conn_threads_.erase(it);
+        }
       }
-      close(fd);
-      active_conns_.fetch_sub(1);
-    }).detach();
+      finished_threads_.clear();
+      conns_.insert(fd);
+      uint64_t id = next_thread_id_++;
+      conn_threads_.emplace(id, std::thread([this, fd, id] {
+        serve_conn(fd);
+        std::lock_guard<std::mutex> g2(conns_mu_);
+        conns_.erase(fd);
+        close(fd);
+        finished_threads_.push_back(id);
+      }));
+    }
+    for (auto& t : reaped)
+      if (t.joinable()) t.join();
   }
 }
 
@@ -376,6 +392,13 @@ void RpcClient::disconnect() {
     close(fd_);
     fd_ = -1;
   }
+}
+
+void RpcClient::abort() {
+  // Intentionally does not take mu_ (a blocked call() holds it). shutdown()
+  // on the fd is safe cross-thread and makes the blocked recv/send fail;
+  // the call() path then disconnects and reconnects on next use.
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
 }
 
 void RpcClient::ensure_connected(int64_t timeout_ms) {

@@ -14,10 +14,12 @@
 #include <atomic>
 #include <condition_variable>
 #include <functional>
+#include <map>
 #include <mutex>
 #include <set>
 #include <string>
 #include <thread>
+#include <vector>
 
 #include "wire.h"
 
@@ -77,7 +79,13 @@ class RpcServer {
 
   std::mutex conns_mu_;
   std::set<int> conns_;
-  std::atomic<int> active_conns_{0};
+  // Joinable connection threads keyed by id; joined in shutdown() after
+  // their fds are shut down and the owner has cancelled any in-handler
+  // waits, so handler state is never touched after the owner destructs.
+  // Finished threads announce themselves so the accept loop can reap.
+  std::map<uint64_t, std::thread> conn_threads_;
+  std::vector<uint64_t> finished_threads_;
+  uint64_t next_thread_id_ = 0;
 };
 
 // ---- client --------------------------------------------------------------
@@ -96,6 +104,10 @@ class RpcClient {
   // Sends {._m=method, ._d=timeout_ms, ...req} and waits for the response.
   // Throws RpcError on transport failure / deadline / non-OK status.
   Value call(const std::string& method, Value req, int64_t timeout_ms);
+
+  // Cross-thread cancel: shuts down the socket so a blocked call() fails
+  // promptly. The client stays usable (it reconnects on the next call).
+  void abort();
 
   const std::string& addr() const { return addr_; }
 

@@ -1,0 +1,14 @@
+import os
+
+# Force JAX onto a virtual 8-device CPU mesh for all tests: multi-chip
+# sharding is validated without TPU hardware (the driver separately
+# dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Let in-process tests exercise the kill RPC without nuking pytest.
+os.environ.setdefault("TORCHFT_TPU_SOFT_KILL", "1")

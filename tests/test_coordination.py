@@ -1,0 +1,469 @@
+"""Coordination-core tests.
+
+Ports the reference's in-file Rust test scenarios to the C++ core:
+  * quorum_compute table tests  (src/lighthouse.rs:582-1001)
+  * compute_quorum_results tables (src/manager.rs:720-850)
+  * live lighthouse/manager e2e    (src/lighthouse.rs:910-952,
+    src/manager.rs:504-549)
+"""
+
+import threading
+import time
+import urllib.request
+from datetime import timedelta
+
+import pytest
+
+from torchft_tpu import _native
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+)
+
+
+def member(rid, step=0, shrink_only=False, world_size=1):
+    return {
+        "replica_id": rid,
+        "address": f"addr_{rid}",
+        "store_address": f"store_{rid}",
+        "step": step,
+        "world_size": world_size,
+        "shrink_only": shrink_only,
+    }
+
+
+def state(now, participants, heartbeats, prev=None, **opt):
+    return {
+        "now": now,
+        "participants": [
+            {"joined_ms": j, "member": m} for j, m in participants
+        ],
+        "heartbeats": [{"replica_id": r, "at_ms": t} for r, t in heartbeats],
+        "prev_quorum": prev,
+        "opt": {
+            "min_replicas": opt.get("min_replicas", 1),
+            "join_timeout_ms": opt.get("join_timeout_ms", 60000),
+            "heartbeat_timeout_ms": opt.get("heartbeat_timeout_ms", 5000),
+        },
+    }
+
+
+def quorum(qid, members):
+    return {"quorum_id": qid, "participants": members, "created": 0}
+
+
+class TestQuorumCompute:
+    def test_empty(self):
+        r = _native.quorum_compute(state(1000, [], []))
+        assert r["quorum"] is None
+
+    def test_join_timeout_waits_for_stragglers(self):
+        # two participants + one extra heartbeating replica (2 of 3 passes
+        # the split-brain guard), within join_timeout -> wait
+        # (src/lighthouse.rs test_quorum_join_timeout)
+        s = state(
+            1000,
+            [(1000, member("a")), (1000, member("b"))],
+            [("a", 1000), ("b", 1000), ("c", 1000)],
+            join_timeout_ms=60000,
+        )
+        r = _native.quorum_compute(s)
+        assert r["quorum"] is None
+        assert "straggler" in r["reason"]
+
+        # after the join timeout has elapsed the quorum forms without c
+        s = state(
+            70000,
+            [(1000, member("a")), (1000, member("b"))],
+            [("a", 69999), ("b", 69999), ("c", 69999)],
+            join_timeout_ms=60000,
+        )
+        r = _native.quorum_compute(s)
+        assert r["quorum"] is not None
+        assert [m["replica_id"] for m in r["quorum"]] == ["a", "b"]
+
+    def test_split_brain_beats_straggler_wait(self):
+        # 1 participant of 2 heartbeating is rejected by the split-brain
+        # guard before the straggler wait is even considered
+        s = state(
+            1000,
+            [(1000, member("a"))],
+            [("a", 1000), ("b", 1000)],
+            join_timeout_ms=60000,
+        )
+        r = _native.quorum_compute(s)
+        assert r["quorum"] is None
+        assert "at least half" in r["reason"]
+
+    def test_all_joined_skips_join_timeout(self):
+        s = state(
+            1000,
+            [(1000, member("a")), (1000, member("b"))],
+            [("a", 1000), ("b", 1000)],
+            join_timeout_ms=60000,
+        )
+        r = _native.quorum_compute(s)
+        assert r["quorum"] is not None
+        assert len(r["quorum"]) == 2
+
+    def test_heartbeat_expiry_excludes_replica(self):
+        # a's heartbeat is stale -> unhealthy -> below min_replicas
+        s = state(
+            10000,
+            [(1000, member("a"))],
+            [("a", 1000)],
+            heartbeat_timeout_ms=5000,
+        )
+        r = _native.quorum_compute(s)
+        assert r["quorum"] is None
+        assert "min_replicas" in r["reason"]
+
+    def test_min_replicas(self):
+        s = state(
+            1000,
+            [(1000, member("a"))],
+            [("a", 1000)],
+            min_replicas=2,
+        )
+        r = _native.quorum_compute(s)
+        assert r["quorum"] is None
+
+    def test_fast_quorum_when_prev_members_all_healthy(self):
+        # prev quorum {a, b}; both are healthy participants again; extra
+        # heartbeating straggler c does NOT delay the fast path
+        # (src/lighthouse.rs:174-187)
+        s = state(
+            1000,
+            [(999, member("a")), (999, member("b"))],
+            [("a", 1000), ("b", 1000), ("c", 1000)],
+            prev=quorum(1, [member("a"), member("b")]),
+            join_timeout_ms=60000,
+        )
+        r = _native.quorum_compute(s)
+        assert r["quorum"] is not None
+        assert "Fast quorum" in r["reason"]
+        assert [m["replica_id"] for m in r["quorum"]] == ["a", "b"]
+
+    def test_no_fast_quorum_when_prev_member_missing(self):
+        s = state(
+            1000,
+            [(999, member("a"))],
+            [("a", 1000), ("b", 1000)],
+            prev=quorum(1, [member("a"), member("b")]),
+            join_timeout_ms=60000,
+        )
+        r = _native.quorum_compute(s)
+        assert r["quorum"] is None  # waiting for straggler b
+
+    def test_split_brain_guard(self):
+        # 2 participants out of 5 heartbeating: 2 <= 5//2 -> rejected
+        # (src/lighthouse.rs:202-213)
+        s = state(
+            100000,
+            [(1, member("a")), (1, member("b"))],
+            [(r, 100000) for r in ["a", "b", "c", "d", "e"]],
+            join_timeout_ms=1,
+        )
+        r = _native.quorum_compute(s)
+        assert r["quorum"] is None
+        assert "at least half" in r["reason"]
+
+        # 3 of 5 passes
+        s = state(
+            100000,
+            [(1, member("a")), (1, member("b")), (1, member("c"))],
+            [(r, 100000) for r in ["a", "b", "c", "d", "e"]],
+            join_timeout_ms=1,
+        )
+        r = _native.quorum_compute(s)
+        assert r["quorum"] is not None
+
+    def test_shrink_only_filters_joiners(self):
+        # shrink_only quorum keeps only prev members; c is excluded even
+        # though healthy (src/lighthouse.rs:167-172 + 1036-1140 scenario)
+        s = state(
+            1000,
+            [
+                (999, member("a", shrink_only=True)),
+                (999, member("b")),
+                (999, member("c")),
+            ],
+            [("a", 1000), ("b", 1000), ("c", 1000)],
+            prev=quorum(1, [member("a"), member("b")]),
+        )
+        r = _native.quorum_compute(s)
+        assert r["quorum"] is not None
+        assert [m["replica_id"] for m in r["quorum"]] == ["a", "b"]
+
+    def test_candidates_sorted_by_replica_id(self):
+        s = state(
+            1000,
+            [(1000, member("z")), (1000, member("a")), (1000, member("m"))],
+            [("z", 1000), ("a", 1000), ("m", 1000)],
+        )
+        r = _native.quorum_compute(s)
+        assert [m["replica_id"] for m in r["quorum"]] == ["a", "m", "z"]
+
+
+class TestComputeQuorumResults:
+    def test_first_step_primary_and_recovery(self):
+        # max_step == 0: non-primary replicas bootstrap from the primary
+        # (src/manager.rs:403-416 + test_compute_quorum_results_first_step)
+        q = quorum(1, [member("a", 0), member("b", 0)])
+        ra = _native.compute_quorum_results(q, "a", 0)
+        rb = _native.compute_quorum_results(q, "b", 0)
+        assert ra["heal"] is False
+        assert ra["recover_dst_ranks"] == [1]
+        assert ra["store_address"] == "store_a"
+        assert rb["heal"] is True
+        assert rb["recover_src_rank"] == 0
+        assert rb["recover_src_manager_address"] == "addr_a"
+        assert rb["max_world_size"] == 2
+        assert rb["replica_world_size"] == 2
+
+    def test_mixed_step_recovery_assignment(self):
+        q = quorum(7, [member("a", 5), member("b", 3), member("c", 5)])
+        ra = _native.compute_quorum_results(q, "a", 0)
+        rb = _native.compute_quorum_results(q, "b", 0)
+        rc = _native.compute_quorum_results(q, "c", 0)
+        assert ra["max_step"] == 5
+        assert ra["max_world_size"] == 2  # cohort {a, c}
+        assert ra["recover_dst_ranks"] == [1]
+        assert rb["heal"] is True
+        assert rb["recover_src_rank"] == 0
+        assert rb["max_rank"] is None  # b not in the max cohort
+        assert rc["recover_dst_ranks"] == []
+        assert rc["max_rank"] == 1
+
+    def test_rank_offsets_recovery_source(self):
+        # local rank shifts the round-robin so different local ranks pull
+        # from different sources (src/manager.rs:434-447)
+        q = quorum(7, [member("a", 5), member("b", 3), member("c", 5)])
+        rb0 = _native.compute_quorum_results(q, "b", 0)
+        rb1 = _native.compute_quorum_results(q, "b", 1)
+        assert rb0["recover_src_rank"] == 0
+        assert rb1["recover_src_rank"] == 2
+
+    def test_primary_store_striped_by_rank(self):
+        q = quorum(7, [member("a", 5), member("c", 5)])
+        r0 = _native.compute_quorum_results(q, "a", 0)
+        r1 = _native.compute_quorum_results(q, "a", 1)
+        assert r0["store_address"] == "store_a"
+        assert r1["store_address"] == "store_c"
+
+    def test_replica_not_in_quorum(self):
+        q = quorum(1, [member("a", 0)])
+        with pytest.raises(RuntimeError):
+            _native.compute_quorum_results(q, "zz", 0)
+
+
+class TestLighthouseE2E:
+    def test_quorum_fast_latency(self):
+        # parity with lighthouse_test.py:44-47 — single-replica quorum with
+        # join_timeout_ms=100 resolves quickly
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1, join_timeout_ms=100)
+        try:
+            c = LighthouseClient(lh.address(), connect_timeout=timedelta(seconds=5))
+            t0 = time.monotonic()
+            q = c.quorum(member("a"), timeout=timedelta(seconds=5))
+            dt = time.monotonic() - t0
+            assert [m["replica_id"] for m in q["participants"]] == ["a"]
+            assert q["quorum_id"] == 1
+            assert dt < 1.0
+            c.close()
+        finally:
+            lh.shutdown()
+
+    def test_heartbeat(self):
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1)
+        try:
+            c = LighthouseClient(lh.address(), connect_timeout=timedelta(seconds=5))
+            c.heartbeat("a")
+            c.close()
+        finally:
+            lh.shutdown()
+
+    def test_dashboard_status(self):
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1, join_timeout_ms=100)
+        try:
+            c = LighthouseClient(lh.address(), connect_timeout=timedelta(seconds=5))
+            c.quorum(member("dash_replica"), timeout=timedelta(seconds=5))
+            addr = lh.address()
+            with urllib.request.urlopen(addr + "/status", timeout=5) as resp:
+                body = resp.read().decode()
+            assert "dash_replica" in body
+            assert "quorum_id" in body
+            with urllib.request.urlopen(addr + "/", timeout=5) as resp:
+                assert resp.status == 200
+            with urllib.request.urlopen(addr + "/status.json", timeout=5) as resp:
+                assert b"quorum_id" in resp.read()
+            c.close()
+        finally:
+            lh.shutdown()
+
+    def test_quorum_id_bumps_only_on_membership_change(self):
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1, join_timeout_ms=100)
+        try:
+            c = LighthouseClient(lh.address(), connect_timeout=timedelta(seconds=5))
+            q1 = c.quorum(member("a", step=1), timeout=timedelta(seconds=5))
+            q2 = c.quorum(member("a", step=2), timeout=timedelta(seconds=5))
+            assert q1["quorum_id"] == q2["quorum_id"]  # same member set
+            c.close()
+        finally:
+            lh.shutdown()
+
+
+class TestManagerE2E:
+    def _setup(self, n_replicas=2, world_size=1, min_replicas=2):
+        lh = LighthouseServer(
+            bind="[::]:0", min_replicas=min_replicas, join_timeout_ms=100
+        )
+        mgrs = [
+            ManagerServer(
+                replica_id=f"rep_{i}",
+                lighthouse_addr=lh.address(),
+                hostname="localhost",
+                bind="[::]:0",
+                store_addr=f"store_{i}",
+                world_size=world_size,
+            )
+            for i in range(n_replicas)
+        ]
+        return lh, mgrs
+
+    def test_quorum_and_commit(self):
+        lh, mgrs = self._setup()
+        try:
+            results = {}
+
+            def run(i):
+                c = ManagerClient(mgrs[i].address(), connect_timeout=timedelta(seconds=10))
+                results[i] = c._quorum(
+                    rank=0, step=0, checkpoint_metadata=f"m{i}",
+                    shrink_only=False, timeout=timedelta(seconds=10),
+                )
+                results[(i, "commit")] = c.should_commit(
+                    0, 0, True, timeout=timedelta(seconds=10)
+                )
+                c.close()
+
+            ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+            assert results[0].quorum_id == results[1].quorum_id
+            assert results[0].replica_world_size == 2
+            assert results[(0, "commit")] is True
+            assert results[(1, "commit")] is True
+            # exactly one of the two bootstraps from the other at step 0
+            assert results[0].heal != results[1].heal
+        finally:
+            for m in mgrs:
+                m.shutdown()
+            lh.shutdown()
+
+    def test_should_commit_one_failure_rejects_all(self):
+        # world_size=2 ranks on one manager; one False vote fails the round
+        # (src/manager.rs:295-347 semantics)
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1, join_timeout_ms=100)
+        mgr = ManagerServer(
+            replica_id="rep_0", lighthouse_addr=lh.address(),
+            hostname="localhost", bind="[::]:0", store_addr="s",
+            world_size=2,
+        )
+        try:
+            out = {}
+
+            def vote(rank, val):
+                c = ManagerClient(mgr.address(), connect_timeout=timedelta(seconds=10))
+                out[rank] = c.should_commit(rank, 0, val, timeout=timedelta(seconds=10))
+                c.close()
+
+            ts = [
+                threading.Thread(target=vote, args=(0, True)),
+                threading.Thread(target=vote, args=(1, False)),
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert out[0] is False
+            assert out[1] is False
+
+            # next round is reset and can succeed
+            ts = [
+                threading.Thread(target=vote, args=(0, True)),
+                threading.Thread(target=vote, args=(1, True)),
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert out[0] is True and out[1] is True
+        finally:
+            mgr.shutdown()
+            lh.shutdown()
+
+    def test_checkpoint_metadata_lookup(self):
+        lh, mgrs = self._setup(n_replicas=1, min_replicas=1)
+        try:
+            c = ManagerClient(mgrs[0].address(), connect_timeout=timedelta(seconds=10))
+            c._quorum(
+                rank=0, step=0, checkpoint_metadata="the-meta",
+                shrink_only=False, timeout=timedelta(seconds=10),
+            )
+            assert c._checkpoint_metadata(0, timeout=timedelta(seconds=5)) == "the-meta"
+            with pytest.raises(RuntimeError):
+                c._checkpoint_metadata(99, timeout=timedelta(seconds=5))
+            c.close()
+        finally:
+            mgrs[0].shutdown()
+            lh.shutdown()
+
+    def test_quorum_timeout_enforced(self):
+        # 1 of 2 local ranks joins -> quorum can't proceed; 10ms deadline
+        # must raise TimeoutError in well under a second
+        # (manager_integ_test.py:356-368 parity)
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1, join_timeout_ms=100)
+        mgr = ManagerServer(
+            replica_id="rep_0", lighthouse_addr=lh.address(),
+            hostname="localhost", bind="[::]:0", store_addr="s",
+            world_size=2,
+        )
+        try:
+            c = ManagerClient(mgr.address(), connect_timeout=timedelta(seconds=10))
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError):
+                c._quorum(
+                    rank=0, step=0, checkpoint_metadata="",
+                    shrink_only=False, timeout=timedelta(milliseconds=10),
+                )
+            assert time.monotonic() - t0 < 1.0
+            c.close()
+        finally:
+            mgr.shutdown()
+            lh.shutdown()
+
+    def test_soft_kill(self):
+        lh, mgrs = self._setup(n_replicas=1, min_replicas=1)
+        try:
+            c = ManagerClient(mgrs[0].address(), connect_timeout=timedelta(seconds=10))
+            c.kill("test")  # TORCHFT_TPU_SOFT_KILL set by conftest
+            c.close()
+        finally:
+            mgrs[0].shutdown()
+            lh.shutdown()
+
+    def test_manager_requires_lighthouse(self):
+        with pytest.raises((RuntimeError, TimeoutError)):
+            ManagerServer(
+                replica_id="rep_0",
+                lighthouse_addr="http://localhost:1",  # nothing listening
+                hostname="localhost", bind="[::]:0", store_addr="s",
+                world_size=1,
+                connect_timeout=timedelta(milliseconds=200),
+            )

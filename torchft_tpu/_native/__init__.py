@@ -33,12 +33,23 @@ _TIMEOUT_CODES = (CANCELLED, DEADLINE_EXCEEDED)
 
 
 def _build() -> None:
-    subprocess.run(
-        ["make", "-s"],
-        cwd=_NATIVE_SRC,
-        check=True,
-        capture_output=True,
-    )
+    # Serialize concurrent first-import builds across worker processes
+    # (multi-rank launches all hit this path on a fresh checkout).
+    import fcntl
+
+    lock_path = os.path.join(_HERE, ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if not os.path.exists(_LIB_PATH):
+                subprocess.run(
+                    ["make", "-s"],
+                    cwd=_NATIVE_SRC,
+                    check=True,
+                    capture_output=True,
+                )
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
 
 
 def _load() -> ctypes.CDLL:
