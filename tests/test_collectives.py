@@ -1,0 +1,255 @@
+"""Collectives conformance tests.
+
+Mirrors the reference's PG test strategy (process_group_test.py:67-251):
+every collective exercised on world-size-1, then multi-rank semantics checks
+with rank threads sharing one store, then reconfiguration.
+"""
+
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+
+import numpy as np
+import pytest
+
+from torchft_tpu.collectives import (
+    CollectivesDummy,
+    CollectivesTcp,
+    ErrorSwallowingCollectives,
+    ReduceOp,
+)
+from torchft_tpu.store import StoreServer
+
+
+@pytest.fixture()
+def store():
+    s = StoreServer()
+    yield s
+    s.shutdown()
+
+
+def _run_world(store, world, fn, prefix="test"):
+    """Run fn(coll, rank) on `world` configured TCP collectives, one thread
+    per rank (the reference's in-process multi-rank harness)."""
+    colls = [
+        CollectivesTcp(timeout=timedelta(seconds=10), hostname="localhost")
+        for _ in range(world)
+    ]
+
+    def start(rank):
+        colls[rank].configure(f"{store.address()}/{prefix}", rank, world)
+        try:
+            return fn(colls[rank], rank)
+        finally:
+            colls[rank].shutdown()
+
+    with ThreadPoolExecutor(max_workers=world) as ex:
+        return list(ex.map(start, range(world)))
+
+
+class TestSingleRank:
+    def test_all_ops(self, store):
+        c = CollectivesTcp(timeout=timedelta(seconds=5), hostname="localhost")
+        c.configure(f"{store.address()}/solo", 0, 1)
+        a = np.arange(8, dtype=np.float32)
+
+        out = c.allreduce([a.copy()], ReduceOp.SUM).wait()
+        np.testing.assert_array_equal(out[0], a)
+
+        ag = c.allgather(a).wait()
+        assert len(ag) == 1
+        np.testing.assert_array_equal(ag[0], a)
+
+        b = a.copy()
+        c.broadcast(b, root=0).wait()
+        np.testing.assert_array_equal(b, a)
+
+        rs = c.reduce_scatter([a.copy()], ReduceOp.SUM).wait()
+        np.testing.assert_array_equal(rs, a)
+
+        a2a = c.alltoall([a.copy()]).wait()
+        np.testing.assert_array_equal(a2a[0], a)
+
+        c.barrier().wait()
+        assert c.size() == 1 and c.rank() == 0
+        c.shutdown()
+
+
+class TestMultiRank:
+    @pytest.mark.parametrize("world", [2, 3, 4])
+    def test_allreduce_sum(self, store, world):
+        def fn(c, rank):
+            a = np.full(13, float(rank + 1), dtype=np.float32)
+            return c.allreduce([a], ReduceOp.SUM).wait(timedelta(seconds=10))[0]
+
+        outs = _run_world(store, world, fn, prefix=f"ar{world}")
+        want = sum(range(1, world + 1))
+        for out in outs:
+            np.testing.assert_allclose(out, want)
+
+    def test_allreduce_avg_and_max(self, store):
+        def fn(c, rank):
+            a = np.full(5, float(rank), dtype=np.float64)
+            avg = c.allreduce([a.copy()], ReduceOp.AVG).wait()[0]
+            mx = c.allreduce([a.copy()], ReduceOp.MAX).wait()[0]
+            return avg, mx
+
+        outs = _run_world(store, 3, fn, prefix="avgmax")
+        for avg, mx in outs:
+            np.testing.assert_allclose(avg, 1.0)  # (0+1+2)/3
+            np.testing.assert_allclose(mx, 2.0)
+
+    def test_allreduce_multiple_arrays_and_dtypes(self, store):
+        def fn(c, rank):
+            xs = [
+                np.full(3, rank + 1, dtype=np.float32),
+                np.full((2, 2), rank + 1, dtype=np.int64),
+            ]
+            return c.allreduce(xs, ReduceOp.SUM).wait()
+
+        outs = _run_world(store, 2, fn, prefix="multi")
+        for xs in outs:
+            np.testing.assert_allclose(xs[0], 3.0)
+            np.testing.assert_array_equal(xs[1], np.full((2, 2), 3))
+
+    def test_allgather(self, store):
+        def fn(c, rank):
+            return c.allgather(np.full(4, rank, dtype=np.float32)).wait()
+
+        outs = _run_world(store, 3, fn, prefix="ag")
+        for got in outs:
+            for r in range(3):
+                np.testing.assert_allclose(got[r], float(r))
+
+    def test_broadcast(self, store):
+        def fn(c, rank):
+            a = (
+                np.arange(6, dtype=np.float32)
+                if rank == 1
+                else np.zeros(6, dtype=np.float32)
+            )
+            c.broadcast(a, root=1).wait()
+            return a
+
+        outs = _run_world(store, 3, fn, prefix="bc")
+        for a in outs:
+            np.testing.assert_allclose(a, np.arange(6, dtype=np.float32))
+
+    def test_reduce_scatter(self, store):
+        world = 3
+
+        def fn(c, rank):
+            # arrays[j] is this rank's contribution to rank j
+            arrays = [
+                np.full(4, (rank + 1) * 10 + j, dtype=np.float32)
+                for j in range(world)
+            ]
+            return c.reduce_scatter(arrays, ReduceOp.SUM).wait()
+
+        outs = _run_world(store, world, fn, prefix="rs")
+        for j, got in enumerate(outs):
+            want = sum((r + 1) * 10 + j for r in range(world))
+            np.testing.assert_allclose(got, float(want))
+
+    def test_alltoall(self, store):
+        world = 3
+
+        def fn(c, rank):
+            arrays = [
+                np.full(2, rank * 10 + j, dtype=np.int32) for j in range(world)
+            ]
+            return c.alltoall(arrays).wait()
+
+        outs = _run_world(store, world, fn, prefix="a2a")
+        for j, got in enumerate(outs):
+            for r in range(world):
+                np.testing.assert_array_equal(got[r], r * 10 + j)
+
+    def test_send_recv(self, store):
+        def fn(c, rank):
+            if rank == 0:
+                c.send(np.arange(5, dtype=np.float32), dst=1, tag=7).wait()
+                return None
+            buf = np.zeros(5, dtype=np.float32)
+            c.recv(buf, src=0, tag=7).wait()
+            return buf
+
+        outs = _run_world(store, 2, fn, prefix="p2p")
+        np.testing.assert_allclose(outs[1], np.arange(5, dtype=np.float32))
+
+    def test_barrier(self, store):
+        def fn(c, rank):
+            c.barrier().wait(timedelta(seconds=10))
+            return True
+
+        assert all(_run_world(store, 3, fn, prefix="bar"))
+
+    def test_large_uneven_allreduce(self, store):
+        # array smaller than world and a large one exercising chunking
+        def fn(c, rank):
+            small = np.full(2, float(rank), dtype=np.float32)
+            big = np.full(100003, float(rank + 1), dtype=np.float32)
+            return c.allreduce([small, big], ReduceOp.SUM).wait(
+                timedelta(seconds=30)
+            )
+
+        outs = _run_world(store, 4, fn, prefix="big")
+        for small, big in outs:
+            np.testing.assert_allclose(small, 6.0)
+            np.testing.assert_allclose(big, 10.0)
+
+    def test_reconfigure_changes_world(self, store):
+        # same objects reconfigured into a smaller epoch, like a shrinking
+        # quorum (process_group_test.py:346-380 reconfiguration checks)
+        colls = [
+            CollectivesTcp(timeout=timedelta(seconds=10), hostname="localhost")
+            for _ in range(3)
+        ]
+
+        def epoch1(rank):
+            colls[rank].configure(f"{store.address()}/e1", rank, 3)
+            a = np.ones(4, dtype=np.float32)
+            return colls[rank].allreduce([a], ReduceOp.SUM).wait()[0]
+
+        with ThreadPoolExecutor(max_workers=3) as ex:
+            outs = list(ex.map(epoch1, range(3)))
+        for out in outs:
+            np.testing.assert_allclose(out, 3.0)
+
+        def epoch2(rank):
+            colls[rank].configure(f"{store.address()}/e2", rank, 2)
+            a = np.ones(4, dtype=np.float32)
+            out = colls[rank].allreduce([a], ReduceOp.SUM).wait()[0]
+            colls[rank].shutdown()
+            return out
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            outs = list(ex.map(epoch2, range(2)))
+        for out in outs:
+            np.testing.assert_allclose(out, 2.0)
+        colls[2].shutdown()
+
+
+class TestWrappers:
+    def test_dummy(self):
+        c = CollectivesDummy(rank=0, world_size=2)
+        a = np.ones(3, dtype=np.float32)
+        assert c.allreduce([a]).wait()[0] is a
+        assert len(c.allgather(a).wait()) == 2
+        c.configure("x", 0, 2)
+        assert c.configure_count == 1
+
+    def test_error_swallowing_latches(self, store):
+        inner = CollectivesTcp(timeout=timedelta(seconds=5), hostname="localhost")
+        wrap = ErrorSwallowingCollectives(inner)
+        # not configured -> first op errors and latches; later ops no-op
+        a = np.ones(3, dtype=np.float32)
+        out = wrap.allreduce([a]).wait()
+        assert wrap.error() is not None
+        out2 = wrap.allreduce([a]).wait()
+        assert out2 == [a]
+        # reconfigure clears the latch
+        wrap.configure(f"{store.address()}/esw", 0, 1)
+        assert wrap.error() is None
+        res = wrap.allreduce([a]).wait()
+        np.testing.assert_allclose(res[0], 1.0)
+        wrap.shutdown()

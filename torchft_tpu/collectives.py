@@ -1,0 +1,751 @@
+"""Reconfigurable collectives — the data plane across replica groups.
+
+The reference's equivalent layer is torch.distributed ProcessGroups that can
+be re-created with a new store/rank/world each quorum
+(/root/reference/torchft/process_group.py). A TPU-native design splits the
+data plane in two:
+
+* **within** a replica group: a jax.sharding.Mesh + pjit/shard_map — XLA
+  emits ICI collectives; nothing here to manage (see torchft_tpu.parallel).
+* **across** replica groups: membership changes every quorum, so these
+  collectives live *outside* jit on host buffers, keeping the compiled step
+  function stable while the replica axis resizes. ``CollectivesTcp`` is that
+  backend (the Gloo analogue, riding DCN); ops take/return numpy arrays and
+  return ``Work`` handles like torch PGs do.
+
+The ``configure(store_addr, rank, world_size)`` verb is the reconfiguration
+point (process_group.py:224-239): it abandons the previous epoch's sockets
+and re-rendezvouses through the epoch-prefixed store namespace
+(``{store}/torchft/{quorum_id}/{rank}`` — manager.py:472).
+
+Wrappers mirror the reference: ``CollectivesDummy`` (no-op backend used to
+soak init ops and for tests, process_group.py:450-558),
+``ErrorSwallowingCollectives`` (first error latches, later ops no-op until
+reconfigure, process_group.py:561-654) and ``ManagedCollectives`` (routes
+through a Manager, process_group.py:657-722).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from abc import ABC, abstractmethod
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from enum import Enum
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from torchft_tpu.futures import Future
+from torchft_tpu.store import create_store_client
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ReduceOp",
+    "Work",
+    "Collectives",
+    "CollectivesTcp",
+    "CollectivesDummy",
+    "ErrorSwallowingCollectives",
+    "ManagedCollectives",
+]
+
+
+class ReduceOp(Enum):
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+
+
+_REDUCE_FNS: Dict[ReduceOp, Callable[[np.ndarray, np.ndarray], np.ndarray]] = {
+    ReduceOp.SUM: lambda a, b: np.add(a, b, out=a),
+    ReduceOp.AVG: lambda a, b: np.add(a, b, out=a),  # divided at the end
+    ReduceOp.MAX: lambda a, b: np.maximum(a, b, out=a),
+    ReduceOp.MIN: lambda a, b: np.minimum(a, b, out=a),
+}
+
+
+class Work:
+    """Async op handle (torch Work analogue)."""
+
+    def __init__(self, fut: Future) -> None:
+        self._fut = fut
+
+    def wait(self, timeout: Optional[timedelta] = None):
+        return self._fut.wait(timeout)
+
+    def get_future(self) -> Future:
+        return self._fut
+
+    @staticmethod
+    def completed(value=None) -> "Work":
+        return Work(Future.completed(value))
+
+
+class Collectives(ABC):
+    """Abstract reconfigurable collectives over a replica axis."""
+
+    @abstractmethod
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        """Tear down the previous epoch and rendezvous a fresh one. Safe to
+        call repeatedly; each call fully replaces connectivity."""
+
+    @abstractmethod
+    def allreduce(self, arrays: List[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        """In-place allreduce of each array; future resolves to the list."""
+
+    @abstractmethod
+    def allgather(self, arr: np.ndarray) -> Work:
+        """Future resolves to a list of ``world_size`` arrays, rank order."""
+
+    @abstractmethod
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> Work:
+        """In-place broadcast from ``root``; future resolves to the array."""
+
+    @abstractmethod
+    def reduce_scatter(
+        self, arrays: List[np.ndarray], op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        """Reduce ``world_size`` per-rank inputs; future resolves to this
+        rank's reduced shard (``arrays[rank]``-shaped)."""
+
+    @abstractmethod
+    def alltoall(self, arrays: List[np.ndarray]) -> Work:
+        """Exchange ``arrays[j]`` to rank j; future resolves to the received
+        list in rank order."""
+
+    @abstractmethod
+    def send(self, arr: np.ndarray, dst: int, tag: int = 0) -> Work: ...
+
+    @abstractmethod
+    def recv(self, arr: np.ndarray, src: int, tag: int = 0) -> Work:
+        """In-place receive into ``arr``."""
+
+    @abstractmethod
+    def barrier(self) -> Work: ...
+
+    @abstractmethod
+    def size(self) -> int: ...
+
+    @abstractmethod
+    def rank(self) -> int: ...
+
+    def shutdown(self) -> None:  # noqa: B027 — optional hook
+        pass
+
+
+# ---------------------------------------------------------------------------
+# TCP backend
+# ---------------------------------------------------------------------------
+
+_HELLO_MAGIC = 0x7F7A0001
+_FRAME_HDR = struct.Struct("<II")  # (tag, length) — tag catches desync bugs
+
+
+def _send_frame(sock: socket.socket, tag: int, payload: memoryview) -> None:
+    sock.sendall(_FRAME_HDR.pack(tag, len(payload)))
+    sock.sendall(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        k = sock.recv_into(view[got:], n - got)
+        if k == 0:
+            raise ConnectionError("peer closed connection")
+        got += k
+    return buf
+
+
+def _recv_frame(sock: socket.socket, expect_tag: int) -> bytearray:
+    hdr = _recv_exact(sock, _FRAME_HDR.size)
+    tag, length = _FRAME_HDR.unpack(bytes(hdr))
+    if tag != expect_tag:
+        raise RuntimeError(f"collective desync: got tag {tag:#x}, want {expect_tag:#x}")
+    return _recv_exact(sock, length)
+
+
+def _bytes_view(arr: np.ndarray) -> memoryview:
+    """Byte-level view of an array (frame lengths are in bytes)."""
+    return memoryview(np.ascontiguousarray(arr)).cast("B")
+
+
+def _flat_view(arr: np.ndarray) -> np.ndarray:
+    """Flat in-place view; in-place collectives need contiguous arrays."""
+    v = arr.reshape(-1)
+    if v.size and not np.shares_memory(v, arr):
+        raise ValueError("in-place collectives require contiguous arrays")
+    return v
+
+
+class _Peer:
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        # One lock per direction: an op may concurrently send to and receive
+        # from the same peer (ring steps do exactly that).
+        self.send_lock = threading.Lock()
+        self.recv_lock = threading.Lock()
+
+
+class CollectivesTcp(Collectives):
+    """Cross-replica-group collectives over TCP (Gloo analogue).
+
+    Full-duplex mesh built lazily: both sides publish listeners through the
+    store; for the pair (i, j) the higher rank dials the lower. Ring
+    algorithms (reduce-scatter + allgather) bound per-step traffic to
+    ``2 * nbytes / world``.
+    """
+
+    def __init__(
+        self,
+        timeout: timedelta = timedelta(seconds=60),
+        hostname: Optional[str] = None,
+    ) -> None:
+        self._timeout = timeout
+        self._hostname = hostname or socket.gethostname()
+        self._rank = -1
+        self._world = 0
+        self._generation = 0
+        self._peers: Dict[int, _Peer] = {}
+        self._peers_lock = threading.Lock()
+        self._listener: Optional[socket.socket] = None
+        self._acceptor: Optional[threading.Thread] = None
+        self._store = None
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._op_seq = 0
+
+    # -- lifecycle --
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self._teardown()
+        self._rank = rank
+        self._world = world_size
+        self._generation += 1
+        gen = self._generation
+        if world_size == 1:
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tft_coll"
+            )
+            return
+
+        self._store = create_store_client(store_addr, connect_timeout=self._timeout)
+        listener = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("::", 0))
+        listener.listen(64)
+        self._listener = listener
+        port = listener.getsockname()[1]
+        self._store.set(f"coll/addr/{rank}", f"{self._hostname}:{port}")
+
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, args=(listener, gen), daemon=True
+        )
+        self._acceptor.start()
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tft_coll"
+        )
+        # Eagerly establish the full mesh so configure() surfaces
+        # connectivity failures (and later ops can't stall on dial).
+        deadline = self._timeout
+        for peer in range(world_size):
+            if peer == rank:
+                continue
+            if peer < rank:
+                self._dial(peer, deadline)
+        # Wait for all higher ranks to dial us.
+        self._wait_for_peers(set(range(rank + 1, world_size)))
+
+    def _wait_for_peers(self, expected: set) -> None:
+        import time
+
+        deadline = time.monotonic() + self._timeout.total_seconds()
+        while True:
+            with self._peers_lock:
+                missing = expected - set(self._peers)
+            if not missing:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"peers never connected: {sorted(missing)}")
+            time.sleep(0.01)
+
+    def _accept_loop(self, listener: socket.socket, gen: int) -> None:
+        while True:
+            try:
+                sock, _ = listener.accept()
+            except OSError:
+                return  # listener closed by teardown
+            try:
+                hello = _recv_exact(sock, 8)
+                magic, peer_rank = struct.unpack("<II", bytes(hello))
+                if magic != _HELLO_MAGIC:
+                    sock.close()
+                    continue
+            except Exception:
+                sock.close()
+                continue
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._peers_lock:
+                if gen != self._generation:
+                    sock.close()
+                    return
+                self._peers[peer_rank] = _Peer(sock)
+
+    def _dial(self, peer: int, timeout: timedelta) -> None:
+        addr = self._store.get(f"coll/addr/{peer}", timeout=timeout).decode()
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection(
+            (host, int(port)), timeout=timeout.total_seconds()
+        )
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.sendall(struct.pack("<II", _HELLO_MAGIC, self._rank))
+        with self._peers_lock:
+            self._peers[peer] = _Peer(sock)
+
+    def _teardown(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        with self._peers_lock:
+            for p in self._peers.values():
+                try:
+                    p.sock.close()
+                except OSError:
+                    pass
+            self._peers.clear()
+        if self._store is not None:
+            self._store.close()
+            self._store = None
+
+    def shutdown(self) -> None:
+        self._teardown()
+
+    def size(self) -> int:
+        return self._world
+
+    def rank(self) -> int:
+        return self._rank
+
+    # -- plumbing --
+
+    def _peer(self, rank: int) -> _Peer:
+        with self._peers_lock:
+            p = self._peers.get(rank)
+        if p is None:
+            raise RuntimeError(f"no connection to peer {rank}")
+        return p
+
+    def _submit(self, fn: Callable) -> Work:
+        assert self._executor is not None, "configure() must be called first"
+        out: Future = Future()
+
+        def run() -> None:
+            try:
+                out.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — propagate via future
+                out.set_exception(e)
+
+        self._executor.submit(run)
+        return Work(out)
+
+    def _send_to(self, rank: int, tag: int, data: memoryview) -> None:
+        p = self._peer(rank)
+        with p.send_lock:
+            _send_frame(p.sock, tag, data)
+
+    def _recv_from(self, rank: int, tag: int) -> bytearray:
+        p = self._peer(rank)
+        with p.recv_lock:
+            return _recv_frame(p.sock, tag)
+
+    def _exchange(
+        self, dst: int, send_data: memoryview, src: int, tag: int
+    ) -> bytearray:
+        """Simultaneously send to dst and receive from src (ring step) —
+        the send runs on a helper thread so large transfers can't deadlock
+        on full OS socket buffers."""
+        err: List[BaseException] = []
+
+        def do_send() -> None:
+            try:
+                self._send_to(dst, tag, send_data)
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+
+        t = threading.Thread(target=do_send, daemon=True)
+        t.start()
+        data = self._recv_from(src, tag)
+        t.join()
+        if err:
+            raise err[0]
+        return data
+
+    def _next_tag(self) -> int:
+        self._op_seq = (self._op_seq + 1) & 0x00FFFFFF
+        return self._op_seq
+
+    # -- collectives (all run on the op thread, SPMD-ordered) --
+
+    def allreduce(self, arrays: List[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        world, rank = self._world, self._rank
+        tag = self._next_tag() | 0x01000000
+
+        def run() -> List[np.ndarray]:
+            if world > 1:
+                for arr in arrays:
+                    self._ring_allreduce(arr, op, tag)
+            if op == ReduceOp.AVG:
+                for arr in arrays:
+                    np.divide(arr, world, out=arr)
+            return arrays
+
+        return self._submit(run)
+
+    def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp, tag: int) -> None:
+        world, rank = self._world, self._rank
+        right = (rank + 1) % world
+        left = (rank - 1) % world
+        reduce_fn = _REDUCE_FNS[op]
+
+        flat = _flat_view(arr)
+        bounds = np.linspace(0, flat.size, world + 1).astype(np.int64)
+        chunks = [flat[bounds[i] : bounds[i + 1]] for i in range(world)]
+
+        # reduce-scatter phase
+        for step in range(world - 1):
+            send_idx = (rank - step) % world
+            recv_idx = (rank - step - 1) % world
+            data = self._exchange(
+                right, _bytes_view(chunks[send_idx]),
+                left, tag,
+            )
+            incoming = np.frombuffer(data, dtype=arr.dtype)
+            reduce_fn(chunks[recv_idx], incoming.reshape(chunks[recv_idx].shape))
+        # allgather phase
+        for step in range(world - 1):
+            send_idx = (rank + 1 - step) % world
+            recv_idx = (rank - step) % world
+            data = self._exchange(
+                right, _bytes_view(chunks[send_idx]),
+                left, tag,
+            )
+            chunks[recv_idx][:] = np.frombuffer(data, dtype=arr.dtype).reshape(
+                chunks[recv_idx].shape
+            )
+
+    def allgather(self, arr: np.ndarray) -> Work:
+        world, rank = self._world, self._rank
+        tag = self._next_tag() | 0x02000000
+
+        def run() -> List[np.ndarray]:
+            out: List[Optional[np.ndarray]] = [None] * world
+            out[rank] = arr.copy()
+            if world > 1:
+                right, left = (rank + 1) % world, (rank - 1) % world
+                cur = np.ascontiguousarray(arr)
+                cur_idx = rank
+                for _ in range(world - 1):
+                    data = self._exchange(right, _bytes_view(cur), left, tag)
+                    cur_idx = (cur_idx - 1) % world
+                    cur = np.frombuffer(data, dtype=arr.dtype).reshape(arr.shape).copy()
+                    out[cur_idx] = cur
+            return out  # type: ignore[return-value]
+
+        return self._submit(run)
+
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> Work:
+        world, rank = self._world, self._rank
+        tag = self._next_tag() | 0x03000000
+
+        def run() -> np.ndarray:
+            if world > 1:
+                if rank == root:
+                    data = _bytes_view(arr)
+                    for peer in range(world):
+                        if peer != rank:
+                            self._send_to(peer, tag, data)
+                else:
+                    data = self._recv_from(root, tag)
+                    _flat_view(arr)[:] = np.frombuffer(data, dtype=arr.dtype)
+            return arr
+
+        return self._submit(run)
+
+    def reduce_scatter(
+        self, arrays: List[np.ndarray], op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        world, rank = self._world, self._rank
+        if len(arrays) != world:
+            raise ValueError(f"reduce_scatter needs {world} inputs, got {len(arrays)}")
+        tag = self._next_tag() | 0x04000000
+        reduce_fn = _REDUCE_FNS[op]
+
+        def run() -> np.ndarray:
+            if world == 1:
+                acc = arrays[0].copy()
+            else:
+                # Same schedule as the allreduce reduce-scatter phase: rank r
+                # fully owns slot (r+1)%world afterwards, so permute inputs
+                # one step (slot i holds input (i-1)%world) to make each rank
+                # end up with the reduction of its *own* input index.
+                right, left = (rank + 1) % world, (rank - 1) % world
+                local = [
+                    np.ascontiguousarray(arrays[(i - 1) % world]).copy()
+                    for i in range(world)
+                ]
+                for step in range(world - 1):
+                    send_idx = (rank - step) % world
+                    recv_idx = (rank - step - 1) % world
+                    data = self._exchange(
+                        right, _bytes_view(local[send_idx]), left, tag
+                    )
+                    incoming = np.frombuffer(data, dtype=local[recv_idx].dtype)
+                    reduce_fn(local[recv_idx], incoming.reshape(local[recv_idx].shape))
+                acc = local[(rank + 1) % world]
+            if op == ReduceOp.AVG:
+                np.divide(acc, world, out=acc)
+            return acc
+
+        return self._submit(run)
+
+    def alltoall(self, arrays: List[np.ndarray]) -> Work:
+        world, rank = self._world, self._rank
+        if len(arrays) != world:
+            raise ValueError(f"alltoall needs {world} inputs, got {len(arrays)}")
+        tag = self._next_tag() | 0x05000000
+
+        def run() -> List[np.ndarray]:
+            out: List[Optional[np.ndarray]] = [None] * world
+            out[rank] = arrays[rank].copy()
+            # Rotation schedule: round r sends to rank+r while receiving
+            # from rank-r (full duplex), which is deadlock-free for any
+            # world size — a pairwise send-then-recv ordering is not.
+            for r in range(1, world):
+                dst = (rank + r) % world
+                src = (rank - r) % world
+                data = self._exchange(dst, _bytes_view(arrays[dst]), src, tag)
+                out[src] = (
+                    np.frombuffer(data, dtype=arrays[src].dtype)
+                    .reshape(arrays[src].shape)
+                    .copy()
+                )
+            return out  # type: ignore[return-value]
+
+        return self._submit(run)
+
+    def send(self, arr: np.ndarray, dst: int, tag: int = 0) -> Work:
+        wire_tag = 0x06000000 | (tag & 0xFFFFFF)
+
+        def run() -> None:
+            self._send_to(dst, wire_tag, _bytes_view(arr))
+
+        return self._submit(run)
+
+    def recv(self, arr: np.ndarray, src: int, tag: int = 0) -> Work:
+        wire_tag = 0x06000000 | (tag & 0xFFFFFF)
+
+        def run() -> np.ndarray:
+            data = self._recv_from(src, wire_tag)
+            _flat_view(arr)[:] = np.frombuffer(data, dtype=arr.dtype)
+            return arr
+
+        return self._submit(run)
+
+    def barrier(self) -> Work:
+        token = np.zeros(1, dtype=np.int32)
+        world = self._world
+        tag = self._next_tag() | 0x07000000
+
+        def run() -> None:
+            if world > 1:
+                self._ring_allreduce(token, ReduceOp.SUM, tag)
+
+        return self._submit(run)
+
+
+# ---------------------------------------------------------------------------
+# Wrappers
+# ---------------------------------------------------------------------------
+
+
+class CollectivesDummy(Collectives):
+    """No-op backend: every op completes immediately with identity results
+    (ProcessGroupDummy analogue, process_group.py:450-558)."""
+
+    def __init__(self, rank: int = 0, world_size: int = 1) -> None:
+        self._rank = rank
+        self._world = world_size
+        self.configure_count = 0
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self._rank, self._world = rank, world_size
+        self.configure_count += 1
+
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        return Work.completed(arrays)
+
+    def allgather(self, arr):
+        return Work.completed([arr.copy() for _ in range(self._world)])
+
+    def broadcast(self, arr, root=0):
+        return Work.completed(arr)
+
+    def reduce_scatter(self, arrays, op=ReduceOp.SUM):
+        return Work.completed(arrays[self._rank].copy())
+
+    def alltoall(self, arrays):
+        return Work.completed([a.copy() for a in arrays])
+
+    def send(self, arr, dst, tag=0):
+        return Work.completed(None)
+
+    def recv(self, arr, src, tag=0):
+        return Work.completed(arr)
+
+    def barrier(self):
+        return Work.completed(None)
+
+    def size(self) -> int:
+        return self._world
+
+    def rank(self) -> int:
+        return self._rank
+
+
+class ErrorSwallowingCollectives(Collectives):
+    """First error latches; subsequent ops are no-ops until the next
+    configure() (ErrorSwallowingProcessGroupWrapper analogue,
+    process_group.py:561-654). Keeps a failed replica from hanging its
+    whole group mid-step — the Manager discards the step at commit time."""
+
+    def __init__(self, inner: Collectives) -> None:
+        self._inner = inner
+        self._error: Optional[Exception] = None
+
+    def error(self) -> Optional[Exception]:
+        return self._error
+
+    def report_error(self, e: Exception) -> None:
+        self._error = e
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self._error = None
+        self._inner.configure(store_addr, rank, world_size)
+
+    def _guard(self, fn: Callable[[], Work], default) -> Work:
+        if self._error is not None:
+            return Work.completed(default)
+        try:
+            work = fn()
+        except Exception as e:
+            self.report_error(e)
+            return Work.completed(default)
+
+        def swallow(fut: Future):
+            exc = fut.exception()
+            if exc is not None and self._error is None:
+                logger.exception("collective failed; latching error: %s", exc)
+                self.report_error(
+                    exc if isinstance(exc, Exception) else RuntimeError(str(exc))
+                )
+                return default
+            return fut.value() if exc is None else default
+
+        return Work(work.get_future().then(swallow))
+
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        return self._guard(lambda: self._inner.allreduce(arrays, op), arrays)
+
+    def allgather(self, arr):
+        return self._guard(
+            lambda: self._inner.allgather(arr),
+            [arr.copy() for _ in range(max(1, self._inner.size()))],
+        )
+
+    def broadcast(self, arr, root=0):
+        return self._guard(lambda: self._inner.broadcast(arr, root), arr)
+
+    def reduce_scatter(self, arrays, op=ReduceOp.SUM):
+        return self._guard(
+            lambda: self._inner.reduce_scatter(arrays, op), arrays[0].copy()
+        )
+
+    def alltoall(self, arrays):
+        return self._guard(lambda: self._inner.alltoall(arrays), arrays)
+
+    def send(self, arr, dst, tag=0):
+        return self._guard(lambda: self._inner.send(arr, dst, tag), None)
+
+    def recv(self, arr, src, tag=0):
+        return self._guard(lambda: self._inner.recv(arr, src, tag), arr)
+
+    def barrier(self):
+        return self._guard(lambda: self._inner.barrier(), None)
+
+    def size(self) -> int:
+        return self._inner.size()
+
+    def rank(self) -> int:
+        return self._inner.rank()
+
+    def shutdown(self) -> None:
+        self._inner.shutdown()
+
+
+class ManagedCollectives(Collectives):
+    """Routes allreduce through a Manager so quorum waits, healing zeros and
+    error reporting apply (ManagedProcessGroup analogue,
+    process_group.py:657-722). ``size()`` reports the *participating* world
+    size, which is how dynamic membership stays invisible to user code."""
+
+    def __init__(self, manager) -> None:
+        self._manager = manager
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        raise RuntimeError("ManagedCollectives is configured by its Manager")
+
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        if len(arrays) != 1:
+            raise ValueError("ManagedCollectives.allreduce takes a single array")
+        return Work(self._manager.allreduce(arrays[0]))
+
+    def allgather(self, arr):
+        raise NotImplementedError("only allreduce is managed")
+
+    def broadcast(self, arr, root=0):
+        raise NotImplementedError("only allreduce is managed")
+
+    def reduce_scatter(self, arrays, op=ReduceOp.SUM):
+        raise NotImplementedError("only allreduce is managed")
+
+    def alltoall(self, arrays):
+        raise NotImplementedError("only allreduce is managed")
+
+    def send(self, arr, dst, tag=0):
+        raise NotImplementedError("only allreduce is managed")
+
+    def recv(self, arr, src, tag=0):
+        raise NotImplementedError("only allreduce is managed")
+
+    def barrier(self):
+        raise NotImplementedError("only allreduce is managed")
+
+    def size(self) -> int:
+        return self._manager.num_participants()
+
+    def rank(self) -> int:
+        rank = self._manager.participating_rank()
+        return rank if rank is not None else 0
