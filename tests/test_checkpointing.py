@@ -1,0 +1,213 @@
+"""Checkpoint transport tests.
+
+Ports the reference's transport coverage (http_transport_test.py,
+pg_transport_test.py, rwlock_test.py, transport_test.py shared harness) to
+JAX pytree state dicts.
+"""
+
+import io
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing import (
+    CollectivesTransport,
+    HTTPTransport,
+    RWLock,
+)
+from torchft_tpu.checkpointing.serialization import (
+    dumps_state,
+    flatten_state,
+    loads_state,
+    unflatten_state,
+)
+from torchft_tpu.collectives import CollectivesTcp
+from torchft_tpu.store import StoreServer
+
+
+def assert_state_equal(a, b):
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        if isinstance(x, (np.ndarray,)) or hasattr(x, "dtype"):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        else:
+            assert x == y
+
+
+STATE = {
+    "model": {
+        "w": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b": np.ones(4, dtype=np.bfloat16)
+        if hasattr(np, "bfloat16")
+        else np.ones(4, dtype=np.float16),
+    },
+    "opt": {"lr": 0.1, "mu": np.zeros((2, 2), dtype=np.float64)},
+    "meta": ("strings", 7, None),
+}
+
+
+class TestSerialization:
+    def test_roundtrip(self):
+        out = loads_state(dumps_state(STATE))
+        assert_state_equal(STATE, out)
+
+    def test_jax_arrays(self):
+        import jax.numpy as jnp
+
+        state = {"x": jnp.arange(8, dtype=jnp.bfloat16), "y": jnp.float32(3.5)}
+        out = loads_state(dumps_state(state))
+        np.testing.assert_array_equal(
+            np.asarray(state["x"]), np.asarray(out["x"])
+        )
+
+    def test_flatten_unflatten(self):
+        header, bufs = flatten_state(STATE)
+        raw = [np.frombuffer(memoryview(b).cast("B"), dtype=np.uint8) for b in bufs]
+        assert_state_equal(STATE, unflatten_state(header, raw))
+
+
+class TestRWLock:
+    def test_readers_shared_writer_exclusive(self):
+        lock = RWLock(timeout=1.0)
+        lock.r_acquire()
+        lock.r_acquire()  # second reader ok
+        with pytest.raises(TimeoutError):
+            lock.w_acquire()
+        lock.r_release()
+        lock.r_release()
+        with lock.write_lock():
+            with pytest.raises(TimeoutError):
+                lock.r_acquire()
+        lock.r_acquire()
+        lock.r_release()
+
+    def test_pending_writer_blocks_new_readers(self):
+        lock = RWLock(timeout=5.0)
+        lock.r_acquire()
+        t = threading.Thread(target=lock.w_acquire)  # parks behind the reader
+        t.start()
+        time.sleep(0.1)
+        # a new reader must queue behind the pending writer, not starve it
+        got_read = threading.Event()
+
+        def late_reader():
+            lock.r_acquire()
+            got_read.set()
+            lock.r_release()
+
+        r = threading.Thread(target=late_reader)
+        r.start()
+        assert not got_read.wait(0.3)
+        lock.r_release()  # writer wins first...
+        t.join(timeout=5)
+        assert lock.w_locked()
+        lock.w_release()  # ...then the late reader proceeds
+        assert got_read.wait(5)
+        r.join(timeout=5)
+
+
+@pytest.mark.parametrize("num_chunks", [0, 3])
+def test_http_transport_roundtrip(num_chunks):
+    send = HTTPTransport(timeout=timedelta(seconds=10), num_chunks=num_chunks)
+    recv = HTTPTransport(timeout=timedelta(seconds=10), num_chunks=num_chunks)
+    try:
+        send.send_checkpoint([1], step=5, state_dict=STATE, timeout=timedelta(seconds=10))
+        out = recv.recv_checkpoint(
+            src_rank=0, metadata=send.metadata(), step=5, timeout=timedelta(seconds=10)
+        )
+        assert_state_equal(STATE, out)
+        # wrong step is rejected
+        with pytest.raises(Exception):
+            recv.recv_checkpoint(
+                src_rank=0,
+                metadata=send.metadata(),
+                step=99,
+                timeout=timedelta(seconds=5),
+            )
+    finally:
+        send.shutdown()
+        recv.shutdown()
+
+
+def test_http_transport_blocks_until_staged():
+    send = HTTPTransport(timeout=timedelta(seconds=10))
+    recv = HTTPTransport(timeout=timedelta(seconds=10))
+    try:
+        results = {}
+
+        def fetch():
+            results["state"] = recv.recv_checkpoint(
+                src_rank=0,
+                metadata=send.metadata(),
+                step=1,
+                timeout=timedelta(seconds=10),
+            )
+
+        t = threading.Thread(target=fetch)
+        t.start()
+        time.sleep(0.3)
+        assert "state" not in results  # GET is parked on the write lock
+        send.send_checkpoint([1], step=1, state_dict=STATE, timeout=timedelta(seconds=10))
+        t.join(timeout=10)
+        assert_state_equal(STATE, results["state"])
+
+        # after disallow, subsequent fetches park until the next staging
+        send.disallow_checkpoint()
+        with pytest.raises(Exception):
+            recv2 = HTTPTransport(timeout=timedelta(milliseconds=300))
+            try:
+                recv2.recv_checkpoint(
+                    src_rank=0,
+                    metadata=send.metadata(),
+                    step=1,
+                    timeout=timedelta(milliseconds=500),
+                )
+            finally:
+                recv2.shutdown()
+    finally:
+        send.shutdown()
+        recv.shutdown()
+
+
+def test_collectives_transport_roundtrip():
+    store = StoreServer()
+    try:
+        colls = [CollectivesTcp(timeout=timedelta(seconds=10)) for _ in range(2)]
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            list(
+                pool.map(
+                    lambda i: colls[i].configure(store.address(), i, 2), range(2)
+                )
+            )
+        transports = [
+            CollectivesTransport(c, timeout=timedelta(seconds=10)) for c in colls
+        ]
+
+        def send():
+            transports[0].send_checkpoint(
+                [1], step=3, state_dict=STATE, timeout=timedelta(seconds=10)
+            )
+
+        def recv():
+            return transports[1].recv_checkpoint(
+                src_rank=0, metadata="<collectives>", step=3, timeout=timedelta(seconds=10)
+            )
+
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            fs = pool.submit(send)
+            fr = pool.submit(recv)
+            fs.result(timeout=20)
+            out = fr.result(timeout=20)
+        assert_state_equal(STATE, out)
+        for c in colls:
+            c.shutdown()
+    finally:
+        store.shutdown()

@@ -1,0 +1,290 @@
+"""Manager runtime unit tests.
+
+Ports the reference's mock-driven Manager coverage
+(torchft/manager_test.py): handcrafted QuorumResults driven through
+start_quorum / allreduce / should_commit with a patched ManagerClient and a
+dummy data plane.
+"""
+
+from datetime import timedelta
+from unittest.mock import MagicMock, patch
+
+import numpy as np
+import pytest
+
+from torchft_tpu.collectives import CollectivesDummy
+from torchft_tpu.coordination import QuorumResult
+from torchft_tpu.manager import (
+    MANAGER_ADDR_KEY,
+    REPLICA_ID_KEY,
+    Manager,
+    WorldSizeMode,
+)
+from torchft_tpu.store import StoreClient, StoreServer
+
+
+def quorum_result(
+    quorum_id=123,
+    replica_rank=1,
+    replica_world_size=2,
+    heal=False,
+    max_step=20,
+    max_rank=None,
+    max_world_size=2,
+    recover_src_rank=None,
+    recover_dst_ranks=(),
+):
+    q = QuorumResult()
+    q.quorum_id = quorum_id
+    q.replica_rank = replica_rank
+    q.replica_world_size = replica_world_size
+    q.recover_src_manager_address = "manager address"
+    q.recover_src_rank = recover_src_rank
+    q.recover_dst_ranks = list(recover_dst_ranks)
+    q.store_address = "store_addr/prefix"
+    q.max_step = max_step
+    q.max_rank = max_rank
+    q.max_world_size = max_world_size
+    q.heal = heal
+    return q
+
+
+@pytest.fixture
+def store_server():
+    s = StoreServer()
+    yield s
+    s.shutdown()
+
+
+class ManagerHarness:
+    def __init__(self, store_server, **kwargs):
+        self.store = StoreClient(store_server.address())
+        self.store.set(MANAGER_ADDR_KEY, "dummy")
+        self.store.set(REPLICA_ID_KEY, "dummy_id")
+        self.collectives = CollectivesDummy(rank=0, world_size=1)
+        self.load_state_dict = MagicMock()
+        self.transport = MagicMock()
+        self.transport.metadata.return_value = "transport_meta"
+        kwargs.setdefault("min_replica_size", 2)
+        kwargs.setdefault("timeout", timedelta(seconds=10))
+        # patch stays active for the harness lifetime: the healing path
+        # constructs a second ManagerClient for the recovery source
+        self._patcher = patch("torchft_tpu.manager.ManagerClient", autospec=True)
+        self._patcher.start()
+        self.manager = Manager(
+            collectives=self.collectives,
+            load_state_dict=self.load_state_dict,
+            state_dict=lambda: {"user_key": 1},
+            rank=1,
+            world_size=2,
+            store_addr=store_server.address(),
+            checkpoint_transport=self.transport,
+            **kwargs,
+        )
+        self.client = self.manager._client
+
+    def shutdown(self):
+        self.manager.shutdown(wait=False)
+        self._patcher.stop()
+
+
+@pytest.fixture
+def harness(store_server):
+    hs = []
+
+    def make(**kwargs):
+        h = ManagerHarness(store_server, **kwargs)
+        hs.append(h)
+        return h
+
+    yield make
+    for h in hs:
+        h.shutdown()
+
+
+def test_state_dict(harness):
+    m = harness().manager
+    assert m.state_dict() == {"step": 0, "batches_committed": 0}
+    m.load_state_dict({"step": 1234, "batches_committed": 2345})
+    assert m.current_step() == 1234
+    assert m.batches_committed() == 2345
+
+
+def test_user_state_dict(harness):
+    h = harness()
+    assert h.manager._manager_state_dict() == {
+        "user": {"user_key": 1},
+        "torchft": {"step": 0, "batches_committed": 0},
+    }
+    h.manager.set_state_dict_fns(h.load_state_dict, lambda: {"new_state": 1})
+    assert h.manager._manager_state_dict()["user"] == {"new_state": 1}
+
+
+def test_quorum_happy(harness):
+    h = harness()
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(max_rank=1)
+
+    assert m._quorum_id == -1
+    assert m.current_step() == 0
+
+    m.start_quorum()
+    t = np.array([1.0, 2.0], dtype=np.float32)
+    m.allreduce(t).wait()
+    np.testing.assert_allclose(t, [0.5, 1.0])  # divided by num_participants=2
+
+    h.client.should_commit.return_value = True
+    assert m.should_commit()
+    assert m._quorum_id == 123
+    assert m.current_step() == 1
+    assert m.batches_committed() == 2
+    assert h.collectives.configure_count == 1
+    h.transport.disallow_checkpoint.assert_called_once()
+
+    # same quorum id -> no reconfigure
+    m.start_quorum()
+    assert m.should_commit()
+    assert h.collectives.configure_count == 1
+
+
+def test_quorum_heal_sync(harness):
+    h = harness(use_async_quorum=False)
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(
+        heal=True, max_step=20, recover_src_rank=0
+    )
+    h.transport.recv_checkpoint.return_value = {
+        "user": {"recovered": True},
+        "torchft": {"step": 20, "batches_committed": 0},
+    }
+
+    m.start_quorum()
+    # sync quorum heals eagerly: state applied before returning
+    assert not m._healing
+    h.load_state_dict.assert_called_once_with({"recovered": True})
+    assert m.current_step() == 20
+    assert m.is_participating()
+
+    h.client.should_commit.return_value = True
+    assert m.should_commit()
+    assert m.current_step() == 21
+
+
+def test_quorum_heal_async_zeroes_contribution(harness):
+    h = harness(use_async_quorum=True)
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(
+        heal=True, max_step=20, max_rank=None, recover_src_rank=0
+    )
+    h.transport.recv_checkpoint.return_value = {
+        "user": {"recovered": True},
+        "torchft": {"step": 20, "batches_committed": 40},
+    }
+
+    m.start_quorum()
+    m.wait_quorum()
+    assert m._healing
+    assert not m.is_participating()
+    assert m.participating_rank() is None
+
+    t = np.ones(4, dtype=np.float32)
+    m.allreduce(t).wait()
+    np.testing.assert_allclose(t, 0)  # healing replica contributes zeros
+
+    h.client.should_commit.return_value = True
+    assert m.should_commit()
+    h.load_state_dict.assert_called_once_with({"recovered": True})
+    assert m.current_step() == 21
+    # batches_committed advances by participants (2) from the restored 40
+    assert m.batches_committed() == 42
+
+
+def test_quorum_send_checkpoint(harness):
+    h = harness()
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(
+        max_rank=1, recover_dst_ranks=(0,), max_step=7
+    )
+    m.start_quorum()
+    m.wait_quorum()
+    h.transport.send_checkpoint.assert_called_once()
+    kwargs = h.transport.send_checkpoint.call_args.kwargs
+    assert kwargs["dst_ranks"] == [0]
+    assert kwargs["step"] == 7
+    assert kwargs["state_dict"]["user"] == {"user_key": 1}
+
+
+def test_error_latching(harness):
+    h = harness()
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(max_rank=1)
+    m.start_quorum()
+
+    m.report_error(RuntimeError("boom"))
+    t = np.ones(2, dtype=np.float32)
+    m.allreduce(t).wait()
+    np.testing.assert_allclose(t, 1.0)  # untouched no-op
+
+    h.client.should_commit.return_value = False
+    assert not m.should_commit()
+    assert m.current_step() == 0
+
+    # next quorum clears the error
+    m.start_quorum()
+    assert m.errored() is None
+
+
+def test_allreduce_error_latches(harness):
+    h = harness()
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(max_rank=1)
+    m.start_quorum()
+
+    h.collectives.allreduce = MagicMock(side_effect=RuntimeError("net down"))
+    t = np.ones(2, dtype=np.float32)
+    m.allreduce(t).wait()  # completes despite the failure
+    assert m.errored() is not None
+
+    h.client.should_commit.return_value = False
+    assert not m.should_commit()
+
+
+def test_not_enough_participants(harness):
+    h = harness()
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(
+        max_rank=0, max_world_size=1, replica_world_size=1
+    )
+    m.start_quorum()
+    m.wait_quorum()
+    assert m.num_participants() == 1  # < min_replica_size=2
+
+    h.client.should_commit.return_value = False
+    assert not m.should_commit()
+    # local vote must have been False
+    assert h.client.should_commit.call_args.args[2] is False
+
+
+def test_fixed_with_spares_demotion(harness):
+    h = harness(world_size_mode=WorldSizeMode.FIXED_WITH_SPARES)
+    m = h.manager
+    # 3 healthy replicas, min_replica_size=2 -> the third is a spare
+    h.client._quorum.return_value = quorum_result(
+        max_rank=2, max_world_size=3, replica_rank=2, replica_world_size=3
+    )
+    m.start_quorum()
+    m.wait_quorum()
+    assert m.num_participants() == 2
+    assert m.participating_rank() is None  # demoted to spare
+    t = np.ones(2, dtype=np.float32)
+    m.allreduce(t).wait()
+    np.testing.assert_allclose(t, 0)  # spare contributes zeros
+
+
+def test_quorum_timeout_propagates(harness):
+    h = harness()
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(max_rank=1)
+    m.start_quorum(timeout=timedelta(seconds=7))
+    m.wait_quorum()
+    assert h.client._quorum.call_args.kwargs["timeout"] == timedelta(seconds=7)

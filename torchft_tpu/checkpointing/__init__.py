@@ -1,0 +1,33 @@
+"""Live checkpoint recovery — peer-to-peer weight transfer at quorum time.
+
+The reference layer is torchft/checkpointing/ (transport ABC + HTTP and
+ProcessGroup transports). Here state dicts are JAX pytrees (arrays +
+arbitrary leaves) streamed as raw host buffers:
+
+* :class:`HTTPTransport` — in-process HTTP server; healing replicas GET
+  ``/checkpoint/{step}/full`` (or metadata + parallel chunks).
+* :class:`CollectivesTransport` — rides the reconfigurable data plane's
+  send/recv (the PGTransport analogue).
+"""
+
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing.collectives_transport import CollectivesTransport
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.checkpointing.serialization import (
+    flatten_state,
+    load_state,
+    save_state,
+    unflatten_state,
+)
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+
+__all__ = [
+    "CheckpointTransport",
+    "HTTPTransport",
+    "CollectivesTransport",
+    "RWLock",
+    "flatten_state",
+    "unflatten_state",
+    "save_state",
+    "load_state",
+]

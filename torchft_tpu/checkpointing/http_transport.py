@@ -1,0 +1,237 @@
+"""HTTP checkpoint transport — the default live-recovery path.
+
+Reference: torchft/checkpointing/http_transport.py (in-process
+ThreadingHTTPServer serving ``/checkpoint/{step}/...``, RWLock-gated so
+GETs block while no checkpoint is staged) and http.py (IPv6 server with a
+deep accept backlog). Same design here, serving JAX pytrees via the raw
+buffer streaming in :mod:`torchft_tpu.checkpointing.serialization`.
+
+Chunked mode (``num_chunks > 0``): the header plus a chunk manifest is
+served at ``/metadata``; array buffers are split round-robin by size into
+``num_chunks`` groups fetched in parallel — the analogue of the reference's
+parallel chunk GETs (http_transport.py:243-266).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import urllib.request
+from contextlib import contextmanager
+from datetime import timedelta
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from concurrent.futures import ThreadPoolExecutor
+from typing import Generic, List, Optional, Tuple, TypeVar
+
+import numpy as np
+
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing.serialization import (
+    as_bytes,
+    flatten_state,
+    unflatten_state,
+)
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+__all__ = ["HTTPTransport"]
+
+
+@contextmanager
+def _timed(what: str):
+    import time
+
+    t0 = time.perf_counter()
+    yield
+    logger.info("%s took %.3fs", what, time.perf_counter() - t0)
+
+
+class _Server(ThreadingHTTPServer):
+    address_family = socket.AF_INET6
+    request_queue_size = 1024
+    daemon_threads = True
+
+
+def _assign_chunks(sizes: List[int], num_chunks: int) -> List[List[int]]:
+    """Greedy size-balanced assignment of buffer indices to chunks."""
+    order = sorted(range(len(sizes)), key=lambda i: -sizes[i])
+    totals = [0] * num_chunks
+    groups: List[List[int]] = [[] for _ in range(num_chunks)]
+    for i in order:
+        c = totals.index(min(totals))
+        groups[c].append(i)
+        totals[c] += sizes[i]
+    for g in groups:
+        g.sort()  # stream each chunk's buffers in deterministic order
+    return groups
+
+
+class HTTPTransport(CheckpointTransport[T], Generic[T]):
+    """Serves the staged checkpoint over HTTP from an in-process server."""
+
+    def __init__(
+        self,
+        timeout: timedelta = timedelta(seconds=60),
+        num_chunks: int = 0,
+        hostname: Optional[str] = None,
+    ) -> None:
+        self._timeout = timeout
+        self._num_chunks = num_chunks
+        self._hostname = hostname or socket.gethostname()
+
+        self._lock = RWLock(timeout=timeout.total_seconds())
+        self._step: Optional[int] = None
+        self._header: Optional[bytes] = None
+        self._buffers: List[np.ndarray] = []
+        self._groups: List[List[int]] = []
+        # serving starts disallowed: readers block until first staging
+        self._lock.w_acquire()
+
+        transport = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_GET(self) -> None:
+                try:
+                    transport._lock.r_acquire()
+                except TimeoutError:
+                    self.send_error(503, "no checkpoint staged within timeout")
+                    return
+                try:
+                    parts = self.path.strip("/").split("/")
+                    # /checkpoint/{step}/{what}
+                    if len(parts) != 3 or parts[0] != "checkpoint":
+                        self.send_error(404, f"bad path {self.path}")
+                        return
+                    step = int(parts[1])
+                    if step != transport._step:
+                        self.send_error(
+                            410, f"step {step} not staged (have {transport._step})"
+                        )
+                        return
+                    what = parts[2]
+                    if what == "full":
+                        payload = transport._render_full()
+                    elif what == "metadata":
+                        payload = transport._render_metadata()
+                    elif what.startswith("chunk_"):
+                        payload = transport._render_chunk(int(what[len("chunk_") :]))
+                    else:
+                        self.send_error(404, f"bad path {self.path}")
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header(
+                        "Content-Length", str(sum(len(p) for p in payload))
+                    )
+                    self.end_headers()
+                    for part in payload:
+                        self.wfile.write(part)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — report to the peer
+                    logger.exception("checkpoint GET failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:
+                        pass
+                finally:
+                    transport._lock.r_release()
+
+        self._server = _Server(("::", 0), Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="tft_ckpt_http", daemon=True
+        )
+        self._thread.start()
+
+    # -- render (read lock held) --
+
+    def _render_full(self) -> List[bytes]:
+        import struct
+
+        assert self._header is not None
+        out = [struct.pack("<Q", len(self._header)), self._header]
+        out.extend(as_bytes(b) for b in self._buffers)
+        return out
+
+    def _render_metadata(self) -> List[bytes]:
+        import pickle
+
+        return [pickle.dumps((self._header, self._groups))]
+
+    def _render_chunk(self, i: int) -> List[bytes]:
+        return [as_bytes(self._buffers[j]) for j in self._groups[i]]
+
+    # -- CheckpointTransport --
+
+    def metadata(self) -> str:
+        return f"http://{self._hostname}:{self._port}"
+
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: T, timeout: timedelta
+    ) -> None:
+        with _timed("staging checkpoint"):
+            header, buffers = flatten_state(state_dict)
+        self._header = header
+        self._buffers = buffers
+        nchunks = min(self._num_chunks, len(buffers)) if self._num_chunks else 0
+        self._groups = (
+            _assign_chunks([b.nbytes for b in buffers], nchunks) if nchunks else []
+        )
+        self._step = step
+        self._lock.w_release()  # open the serving window
+
+    def disallow_checkpoint(self) -> None:
+        if not self._lock.w_locked():
+            self._lock.w_acquire()
+
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: timedelta
+    ) -> T:
+        base = f"{metadata}/checkpoint/{step}"
+        secs = timeout.total_seconds()
+        if self._num_chunks == 0:
+            with _timed("fetching full checkpoint"), urllib.request.urlopen(
+                f"{base}/full", timeout=secs
+            ) as resp:
+                from torchft_tpu.checkpointing.serialization import load_state
+
+                return load_state(resp)
+
+        import pickle
+
+        with urllib.request.urlopen(f"{base}/metadata", timeout=secs) as resp:
+            header, groups = pickle.loads(resp.read())
+        _, infos = pickle.loads(header)
+        arr_infos = [i for i in infos if i[0] == "arr"]
+        buffers: List[Optional[np.ndarray]] = [None] * len(arr_infos)
+
+        def fetch(ci: int) -> None:
+            with urllib.request.urlopen(f"{base}/chunk_{ci}", timeout=secs) as r:
+                for j in groups[ci]:
+                    nbytes = arr_infos[j][3]
+                    raw = r.read(nbytes)
+                    if len(raw) != nbytes:
+                        raise EOFError(f"truncated chunk {ci}")
+                    buffers[j] = np.frombuffer(raw, dtype=np.uint8)
+
+        with _timed("fetching chunked checkpoint"):
+            with ThreadPoolExecutor(max_workers=len(groups) or 1) as pool:
+                for f in [pool.submit(fetch, ci) for ci in range(len(groups))]:
+                    f.result()
+        return unflatten_state(header, [b for b in buffers if b is not None])
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if wait:
+            self._thread.join(timeout=5)

@@ -1,0 +1,514 @@
+"""Manager — the per-replica fault-tolerance runtime.
+
+Re-implements the reference's Manager state machine
+(/root/reference/torchft/manager.py:87-728) for a JAX data plane:
+
+* ``start_quorum`` kicks off an async quorum on a worker thread so the
+  quorum RPC overlaps the forward pass (manager.py:366-416).
+* ``allreduce`` averages host gradient buffers across replica groups via
+  the reconfigurable collectives; healing/spare replicas contribute zeros
+  and the division is by ``num_participants()``, not world size
+  (manager.py:243-304).
+* ``should_commit`` is the per-step commit barrier: drain pending work,
+  apply any staged recovery state, vote through the manager server; the
+  optimizer steps only on a unanimous True (manager.py:546-599).
+
+TPU framing: within a replica group, parallelism is a jax Mesh and XLA's
+own ICI collectives (torchft_tpu.parallel); the Manager governs only the
+*cross-replica-group* axis, which lives outside jit on host buffers so the
+compiled train step never recompiles when membership changes.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+import socket
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
+
+import numpy as np
+
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.collectives import Collectives, ReduceOp
+from torchft_tpu.coordination import ManagerClient, ManagerServer
+from torchft_tpu.futures import Future, future_timeout
+from torchft_tpu.store import StoreClient
+
+T = TypeVar("T")
+
+logger = logging.getLogger(__name__)
+
+MANAGER_ADDR_KEY: str = "manager/addr"
+REPLICA_ID_KEY: str = "manager/replica_id"
+MANAGER_PORT_ENV: str = "TORCHFT_MANAGER_PORT"
+LIGHTHOUSE_ENV: str = "TORCHFT_LIGHTHOUSE"
+STORE_ADDR_ENV: str = "TORCHFT_STORE_ADDR"
+
+__all__ = ["Manager", "WorldSizeMode"]
+
+
+class WorldSizeMode(Enum):
+    """Numerics policy when replica groups die (manager.py:55-70).
+
+    DYNAMIC: batch size scales with the live group count — gradients divide
+    by the *current* participant count.
+    FIXED_WITH_SPARES: world size is pinned at ``min_replica_size``; extra
+    groups are demoted to hot spares that contribute zeros, so the divisor
+    (and effective batch size) never changes.
+    """
+
+    DYNAMIC = 0
+    FIXED_WITH_SPARES = 1
+
+
+class _ManagerLogger:
+    """Prefixes every line with ``[replica_id/rank - step N]``
+    (manager.py:709-728)."""
+
+    def __init__(self, manager: "Manager", replica_id: str, rank: int) -> None:
+        self._logger = logging.getLogger("torchft_tpu.manager")
+        self._replica_id = replica_id
+        self._rank = rank
+        self._manager = manager
+
+    def _prefix(self) -> str:
+        return f"[{self._replica_id}/{self._rank} - step {self._manager.current_step()}]"
+
+    def info(self, msg: str) -> None:
+        self._logger.info(f"{self._prefix()} {msg}")
+
+    def warn(self, msg: str) -> None:
+        self._logger.warning(f"{self._prefix()} {msg}")
+
+    def exception(self, msg: str) -> None:
+        self._logger.exception(f"{self._prefix()} {msg}")
+
+
+class Manager:
+    """Fault-tolerance manager for one rank of one replica group."""
+
+    def __init__(
+        self,
+        collectives: Collectives,
+        load_state_dict: Optional[Callable[[T], None]],
+        state_dict: Optional[Callable[[], T]],
+        min_replica_size: int,
+        use_async_quorum: bool = True,
+        timeout: timedelta = timedelta(seconds=60),
+        quorum_timeout: timedelta = timedelta(seconds=60),
+        connect_timeout: timedelta = timedelta(seconds=60),
+        rank: Optional[int] = None,
+        world_size: Optional[int] = None,
+        world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+        store_addr: Optional[str] = None,
+        lighthouse_addr: Optional[str] = None,
+        replica_id: Optional[str] = None,
+        port: Optional[int] = None,
+        hostname: Optional[str] = None,
+        heartbeat_interval: timedelta = timedelta(milliseconds=100),
+        checkpoint_transport: Optional[CheckpointTransport[Dict[str, T]]] = None,
+    ) -> None:
+        """
+        Args:
+            collectives: the reconfigurable cross-replica-group collectives
+                (unconfigured; the Manager configures it each quorum change)
+            load_state_dict / state_dict: user snapshot/restore callbacks for
+                live recovery (set later via :meth:`set_state_dict_fns` if
+                the model is built after the manager)
+            min_replica_size: minimum replica groups for a step to commit
+            use_async_quorum: overlap the quorum RPC with the forward pass
+            timeout: default deadline for collectives, commit votes, and
+                checkpoint transfers
+            quorum_timeout: deadline for quorum formation — must exceed the
+                interval between syncs (≈1h for infrequent LocalSGD syncs)
+            rank / world_size: this rank within the replica group (env RANK /
+                WORLD_SIZE fallback)
+            store_addr: ``host:port`` of the replica group's KV store
+                (TORCHFT_STORE_ADDR fallback)
+            lighthouse_addr: rank-0 only; TORCHFT_LIGHTHOUSE fallback
+            replica_id: rank-0 only; a uuid4 suffix is always appended so
+                restarted groups are distinct lighthouse members
+            port: rank-0 manager server port (TORCHFT_MANAGER_PORT fallback,
+                else ephemeral)
+        """
+        self._load_state_dict = load_state_dict
+        self._user_state_dict = state_dict
+        self._pending_state_dict: Optional[Dict[str, object]] = None
+        self._use_async_quorum = use_async_quorum
+        self._timeout = timeout
+        self._quorum_timeout = quorum_timeout
+        self._connect_timeout = connect_timeout
+        self._world_size_mode = world_size_mode
+        self._min_replica_size = min_replica_size
+
+        store_addr = store_addr or os.environ[STORE_ADDR_ENV]
+        self._rank: int = rank if rank is not None else int(os.environ["RANK"])
+        rank = self._rank
+        world_size = world_size or int(os.environ["WORLD_SIZE"])
+
+        if checkpoint_transport is None:
+            checkpoint_transport = HTTPTransport(timeout=timeout, num_chunks=0)
+        self._checkpoint_transport: CheckpointTransport[Dict[str, T]] = (
+            checkpoint_transport
+        )
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="async_quorum"
+        )
+        self._quorum_future: Optional[concurrent.futures.Future] = None
+
+        self._store = StoreClient(store_addr, connect_timeout=connect_timeout)
+        self._collectives = collectives
+        self._manager: Optional[ManagerServer] = None
+
+        if rank == 0:
+            if port is None:
+                port = int(os.environ.get(MANAGER_PORT_ENV, 0))
+            lighthouse_addr = lighthouse_addr or os.environ[LIGHTHOUSE_ENV]
+            replica_id = (replica_id or "") + str(uuid.uuid4())
+            self._manager = ManagerServer(
+                replica_id=replica_id,
+                lighthouse_addr=lighthouse_addr,
+                hostname=hostname or socket.gethostname(),
+                bind=f"[::]:{port}",
+                store_addr=store_addr,
+                world_size=world_size,
+                heartbeat_interval=heartbeat_interval,
+                connect_timeout=connect_timeout,
+            )
+            self._store.set(MANAGER_ADDR_KEY, self._manager.address())
+            self._store.set(REPLICA_ID_KEY, replica_id)
+
+        addr = self._store.get(MANAGER_ADDR_KEY).decode()
+        self._client = ManagerClient(addr, connect_timeout=connect_timeout)
+        replica_id = self._store.get(REPLICA_ID_KEY).decode()
+        self._logger = _ManagerLogger(self, replica_id or "", rank)
+
+        self._step = 0
+        self._quorum_id = -1
+        self._errored: Optional[Exception] = None
+        self._healing = False
+        self._pending_work: List[Future] = []
+        self._batches_committed = 0
+
+        self._participating_rank: Optional[int] = None
+        self._participating_world_size: int = 0
+
+    def set_state_dict_fns(
+        self, load_state_dict: Callable[[T], None], state_dict: Callable[[], T]
+    ) -> None:
+        self._load_state_dict = load_state_dict
+        self._user_state_dict = state_dict
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Shut down the manager, checkpoint transport and data plane."""
+        self._checkpoint_transport.shutdown(wait=wait)
+        if self._manager is not None:
+            self._manager.shutdown()
+        self._executor.shutdown(wait=wait)
+        self._collectives.shutdown()
+
+    # ------------------------------------------------------------------
+    # quorum
+    # ------------------------------------------------------------------
+
+    def start_quorum(
+        self,
+        allow_heal: bool = True,
+        shrink_only: bool = False,
+        timeout: Optional[timedelta] = None,
+    ) -> None:
+        """Compute a new quorum (async by default) and ready the manager for
+        a new step. Call before the forward pass; the RPC overlaps compute.
+
+        All replicas must pass the same ``allow_heal``. With
+        ``shrink_only`` the quorum can only lose members (planned
+        downscale)."""
+        # wait for a previous quorum to finish before mutating state
+        if self._quorum_future is not None:
+            self._quorum_future.result()
+
+        self._errored = None
+        self._healing = False
+
+        self._quorum_future = self._executor.submit(
+            self._async_quorum,
+            allow_heal=allow_heal,
+            shrink_only=shrink_only,
+            quorum_timeout=timeout or self._quorum_timeout,
+        )
+        if not self._use_async_quorum:
+            self.wait_quorum()
+            if self._healing:
+                # eagerly apply the recovered state so the forward pass runs
+                # from a good state; no zero-grad dance needed
+                self._apply_pending_state_dict()
+                self._healing = False
+
+    def wait_quorum(self) -> None:
+        """Block until the in-flight quorum completes; the data plane is
+        configured for the new membership after this returns."""
+        assert (
+            self._quorum_future is not None
+        ), "must call start_quorum before wait_quorum"
+        self._quorum_future.result()
+
+    def _async_quorum(
+        self, allow_heal: bool, shrink_only: bool, quorum_timeout: timedelta
+    ) -> None:
+        quorum = self._client._quorum(
+            rank=self._rank,
+            step=self._step,
+            checkpoint_metadata=self._checkpoint_transport.metadata(),
+            shrink_only=shrink_only,
+            timeout=quorum_timeout,
+        )
+
+        # Async quorum overlaps the forward pass, so a healing replica can't
+        # participate this step (its state is mid-flight) — take the max-step
+        # cohort. Sync quorum heals eagerly, so everyone participates.
+        self._participating_rank, self._participating_world_size = (
+            (quorum.max_rank, quorum.max_world_size)
+            if self._use_async_quorum or not allow_heal
+            else (quorum.replica_rank, quorum.replica_world_size)
+        )
+
+        if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
+            # demote groups beyond min_replica_size to zero-contributing spares
+            self._participating_world_size = min(
+                self._participating_world_size, self._min_replica_size
+            )
+            if (
+                self._participating_rank is not None
+                and self._participating_rank >= self._min_replica_size
+            ):
+                self._participating_rank = None
+
+        if quorum.quorum_id != self._quorum_id:
+            # epoch-scoped rendezvous namespace on the primary's store
+            store_prefixed_addr = (
+                f"{quorum.store_address}/torchft/{quorum.quorum_id}/{self._rank}"
+            )
+            self._logger.info(
+                f"reconfiguring for quorum_id={quorum.quorum_id} store={store_prefixed_addr}"
+            )
+            self._collectives.configure(
+                store_prefixed_addr, quorum.replica_rank, quorum.replica_world_size
+            )
+            self._quorum_id = quorum.quorum_id
+
+        if allow_heal:
+            if quorum.recover_dst_ranks:
+                self._logger.info(
+                    f"peers need recovery from us {quorum.recover_dst_ranks}"
+                )
+                self._checkpoint_transport.send_checkpoint(
+                    dst_ranks=quorum.recover_dst_ranks,
+                    step=quorum.max_step,
+                    state_dict=self._manager_state_dict(),
+                    timeout=self._timeout,
+                )
+            if quorum.heal:
+                self._healing = True
+                self._logger.info(
+                    f"healing: fetching checkpoint metadata from "
+                    f"{quorum.recover_src_manager_address} at step {quorum.max_step}"
+                )
+                primary_client = ManagerClient(
+                    quorum.recover_src_manager_address,
+                    connect_timeout=self._connect_timeout,
+                )
+                try:
+                    checkpoint_metadata = primary_client._checkpoint_metadata(
+                        self._rank, timeout=self._timeout
+                    )
+                finally:
+                    primary_client.close()
+                assert (
+                    quorum.recover_src_rank is not None
+                ), "must have a recover rank when healing"
+
+                # the user state dict is only applied from the main thread;
+                # stage it here
+                self._pending_state_dict = cast(
+                    Dict[str, object],
+                    self._checkpoint_transport.recv_checkpoint(
+                        src_rank=quorum.recover_src_rank,
+                        metadata=checkpoint_metadata,
+                        step=quorum.max_step,
+                        timeout=self._timeout,
+                    ),
+                )
+                self.load_state_dict(
+                    cast(Dict[str, int], self._pending_state_dict["torchft"])
+                )
+                # load_state_dict above already restores it, but being
+                # explicit keeps the invariant obvious
+                self._step = quorum.max_step
+
+    def _apply_pending_state_dict(self) -> None:
+        assert self._healing, "must be in healing state"
+        assert self._quorum_future is not None, "missing quorum future"
+        self._quorum_future.result()
+        assert self._pending_state_dict is not None, "checkpoint was not staged"
+        assert self._load_state_dict is not None, "user load_state_dict not set"
+        self._logger.info("applying pending state dict")
+        self._load_state_dict(cast(T, self._pending_state_dict["user"]))
+        self._pending_state_dict = None
+
+    # ------------------------------------------------------------------
+    # collectives
+    # ------------------------------------------------------------------
+
+    def allreduce(self, tensor: np.ndarray) -> Future:
+        """Fault-tolerant cross-replica-group allreduce of a host buffer,
+        scaled by ``1 / num_participants()``.
+
+        On error the future still completes (with the possibly-corrupt
+        tensor) and the error is latched — subsequent calls no-op and the
+        step fails at the commit barrier. Healing/spare replicas contribute
+        zeros so the participants' average is unperturbed."""
+        if self.errored():
+            return Future.completed(tensor)
+
+        self.wait_quorum()
+
+        if not self.is_participating():
+            tensor[...] = 0
+
+        try:
+            work = self._collectives.allreduce([tensor], ReduceOp.SUM)
+
+            def normalize(fut: Future) -> np.ndarray:
+                fut.value()  # surface exceptions
+                np.divide(tensor, self.num_participants(), out=tensor)
+                return tensor
+
+            return self.wrap_future(work.get_future().then(normalize), tensor)
+        except Exception as e:  # noqa: BLE001 — latch and continue
+            self._logger.exception(f"exception in allreduce, skipping remaining: {e}")
+            self.report_error(e)
+            return Future.completed(tensor)
+
+    def report_error(self, e: Exception) -> None:
+        """Latch an error: the current step will not commit and the data
+        plane reconfigures on the next quorum."""
+        self._errored = e
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    def wrap_future(
+        self, fut: Future, default: Any, timeout: Optional[timedelta] = None
+    ) -> Future:
+        """Deadline + error-swallowing wrapper: failures complete the future
+        with ``default`` and latch the error on the manager
+        (manager.py:327-364)."""
+        fut = future_timeout(fut, timeout or self._timeout)
+
+        def callback(f: Future) -> Any:
+            try:
+                return f.value()
+            except Exception as e:  # noqa: BLE001
+                self._logger.exception(f"exception in future, skipping remaining: {e}")
+                self.report_error(e)
+                return default
+
+        out = fut.then(callback)
+        self._pending_work.append(out)
+        return out
+
+    # ------------------------------------------------------------------
+    # commit
+    # ------------------------------------------------------------------
+
+    def should_commit(self, timeout: Optional[timedelta] = None) -> bool:
+        """Per-step commit barrier: True iff every rank in the group had a
+        clean step. Call after backward, step the optimizer only on True."""
+        for work in self._pending_work:
+            if self._errored is not None:
+                break
+            try:
+                work.wait()
+            except Exception:
+                # wrap_future already latched it
+                pass
+        self._pending_work = []
+
+        if self._healing:
+            self._apply_pending_state_dict()
+
+        enough_replicas = self.num_participants() >= self._min_replica_size
+        local_should_commit = enough_replicas and self._errored is None
+        should_commit = self._client.should_commit(
+            self._rank,
+            self._step,
+            local_should_commit,
+            timeout=timeout or self._timeout,
+        )
+        self._logger.info(
+            f"should_commit={should_commit} enough_replicas={enough_replicas} "
+            f"errored={self._errored}"
+        )
+
+        # close the checkpoint-serving window: after the commit the staged
+        # state is stale
+        self._checkpoint_transport.disallow_checkpoint()
+
+        if should_commit:
+            self._step += 1
+            self._batches_committed += self.num_participants()
+        return should_commit
+
+    # ------------------------------------------------------------------
+    # state
+    # ------------------------------------------------------------------
+
+    def load_state_dict(self, state_dict: Dict[str, int]) -> None:
+        """Restore manager progress counters (pair with the user's periodic
+        checkpoint of model/optimizer/dataloader state)."""
+        self._step = state_dict["step"]
+        self._batches_committed = state_dict["batches_committed"]
+
+    def _manager_state_dict(self) -> Dict[str, object]:
+        assert self._user_state_dict is not None, "user state_dict not set"
+        return {"user": self._user_state_dict(), "torchft": self.state_dict()}
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"step": self._step, "batches_committed": self._batches_committed}
+
+    def current_step(self) -> int:
+        """Current step count; incremented only on committed steps, so all
+        participants agree on it."""
+        return self._step
+
+    def batches_committed(self) -> int:
+        """Total batches committed across all replica groups and steps."""
+        return self._batches_committed
+
+    def num_participants(self) -> int:
+        """Replica groups participating in the current step."""
+        self.wait_quorum()
+        assert self._participating_world_size >= 0
+        return self._participating_world_size
+
+    def participating_rank(self) -> Optional[int]:
+        """This group's rank among the participating groups, or None for
+        spectators (spares, healing replicas)."""
+        self.wait_quorum()
+        return self._participating_rank
+
+    def is_participating(self) -> bool:
+        """Whether this replica's contributions count this step."""
+        if self._participating_rank is None:
+            return False
+        if self._healing:
+            assert self._use_async_quorum
+            return False
+        return True
