@@ -1,0 +1,270 @@
+"""End-to-end integration: multi-replica-group training in one process.
+
+Ports the reference's Runner/TrainLoop harness (manager_integ_test.py):
+real C++ lighthouse + manager servers on localhost, replica groups as
+threads, TCP collectives across groups, HTTP checkpoint recovery, and
+failure injection as exceptions at chosen (rank, step) with torchelastic-
+style restart attempts.
+"""
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+import pytest
+
+from torchft_tpu.collectives import CollectivesTcp
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.manager import Manager
+from torchft_tpu.optim import ManagedOptimizer
+from torchft_tpu.store import StoreServer
+
+logger = logging.getLogger(__name__)
+
+
+class InjectedFailure(Exception):
+    pass
+
+
+class FailureInjector:
+    """Thread-safe (rank, step) -> raise-once failure injection
+    (manager_integ_test.py:43-61)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._failures: Set[Tuple[int, int]] = set()
+        self.count = 0
+
+    def fail_at(self, rank: int, step: int) -> "FailureInjector":
+        with self._lock:
+            self._failures.add((rank, step))
+            return self
+
+    def check(self, rank: int, step: int) -> None:
+        with self._lock:
+            key = (rank, step)
+            if key in self._failures:
+                self.count += 1
+                self._failures.remove(key)
+                logger.warning("injecting failure rank=%s step=%s", rank, step)
+                raise InjectedFailure(f"injected failure {rank=} {step=}")
+
+
+@dataclass
+class Runner:
+    """One replica group: a store server + world_size rank threads, restarted
+    up to ``attempts`` times on injected failure (torchelastic analogue)."""
+
+    replica_id: int
+    lighthouse_address: str
+    failure_injector: FailureInjector
+    train_loop: Callable[..., Dict[str, Any]]
+    world_size: int = 1
+    attempts: int = 3
+    manager_args: Dict[str, Any] = field(default_factory=dict)
+    train_loop_args: Dict[str, Any] = field(default_factory=dict)
+
+    def _replica_main(self) -> List[Dict[str, Any]]:
+        store = StoreServer()
+        try:
+            with ThreadPoolExecutor(
+                max_workers=self.world_size,
+                thread_name_prefix=f"replica{self.replica_id}",
+            ) as executor:
+                futures = [
+                    executor.submit(
+                        self.train_loop,
+                        rank=rank,
+                        store_addr=store.address(),
+                        runner=self,
+                    )
+                    for rank in range(self.world_size)
+                ]
+                for fut in as_completed(futures):
+                    fut.result()  # surface the first failure
+                return [fut.result() for fut in futures]
+        finally:
+            store.shutdown()
+
+    def run_replica(self) -> List[Dict[str, Any]]:
+        for i in range(self.attempts):
+            try:
+                logger.info(
+                    "starting replica group %s attempt %s", self.replica_id, i
+                )
+                return self._replica_main()
+            except InjectedFailure as e:
+                logger.info("got injected failure %s %s", i, e)
+                if i == self.attempts - 1:
+                    raise
+                continue
+        raise RuntimeError("ran out of attempts")
+
+
+def _init_params(seed: int = 42) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.standard_normal((3, 4)).astype(np.float32),
+        "b": np.zeros(4, dtype=np.float32),
+    }
+
+
+def _loss_fn(params, x, y):
+    import jax.numpy as jnp
+
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def ddp_train_loop(
+    rank: int, store_addr: str, runner: Runner, total_steps: int = 4
+) -> Dict[str, Any]:
+    import jax
+    import optax
+
+    manager = Manager(
+        collectives=CollectivesTcp(timeout=timedelta(seconds=10)),
+        load_state_dict=None,  # wired by ManagedOptimizer.init
+        state_dict=None,
+        min_replica_size=2,
+        replica_id=str(runner.replica_id),
+        store_addr=store_addr,
+        rank=rank,
+        world_size=runner.world_size,
+        lighthouse_addr=runner.lighthouse_address,
+        timeout=timedelta(seconds=10),
+        quorum_timeout=timedelta(seconds=30),
+        **runner.manager_args,
+    )
+    try:
+        opt = ManagedOptimizer(manager, optax.sgd(0.05))
+        opt.init(_init_params())
+        grad_fn = jax.jit(jax.grad(_loss_fn))
+
+        data_rng = np.random.default_rng(1000 + runner.replica_id * 17 + rank)
+        while True:
+            opt.begin_step()
+            x = data_rng.standard_normal((8, 3)).astype(np.float32)
+            y = data_rng.standard_normal((8, 4)).astype(np.float32)
+            grads = grad_fn(opt.params, x, y)
+            opt.step(grads)
+
+            if manager.current_step() >= total_steps:
+                break
+            runner.failure_injector.check(rank, manager.current_step())
+
+        return {
+            "params": jax.tree_util.tree_map(np.asarray, opt.params),
+            "step": manager.current_step(),
+        }
+    finally:
+        manager.shutdown(wait=False)
+
+
+def _run_groups(
+    lighthouse: LighthouseServer,
+    injectors: List[FailureInjector],
+    world_size: int = 1,
+    manager_args: Optional[Dict[str, Any]] = None,
+) -> List[List[Dict[str, Any]]]:
+    num_replicas = len(injectors)
+    with ThreadPoolExecutor(max_workers=num_replicas) as executor:
+        futures = [
+            executor.submit(
+                Runner(
+                    replica_id=replica_id,
+                    lighthouse_address=lighthouse.address(),
+                    failure_injector=injector,
+                    train_loop=ddp_train_loop,
+                    world_size=world_size,
+                    manager_args=manager_args or {},
+                ).run_replica
+            )
+            for replica_id, injector in enumerate(injectors)
+        ]
+        return [f.result(timeout=120) for f in futures]
+
+
+def assert_rank_states_equal(results: List[List[Dict[str, Any]]]) -> None:
+    """Rank-lane r of every group must hold bit-identical params."""
+    for rank in range(len(results[0])):
+        ref = results[0][rank]["params"]
+        for group in results[1:]:
+            for key in ref:
+                np.testing.assert_array_equal(ref[key], group[rank]["params"][key])
+
+
+def test_ddp_healthy():
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+    try:
+        results = _run_groups(lighthouse, [FailureInjector(), FailureInjector()])
+    finally:
+        lighthouse.shutdown()
+    assert_rank_states_equal(results)
+    assert all(r["step"] >= 4 for group in results for r in group)
+
+
+@pytest.mark.parametrize("use_async_quorum", [True, False])
+def test_ddp_recovery(use_async_quorum):
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+    injectors = [FailureInjector(), FailureInjector().fail_at(0, 2)]
+    try:
+        results = _run_groups(
+            lighthouse,
+            injectors,
+            manager_args={"use_async_quorum": use_async_quorum},
+        )
+    finally:
+        lighthouse.shutdown()
+    assert_rank_states_equal(results)
+    assert injectors[1].count == 1
+
+
+def test_ddp_recovery_multi_rank():
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+    # both ranks of the group die together (a half-dead group can only be
+    # cleared by the quorum timeout, so the reference also kills whole groups)
+    injectors = [FailureInjector(), FailureInjector().fail_at(0, 2).fail_at(1, 2)]
+    try:
+        results = _run_groups(lighthouse, injectors, world_size=2)
+    finally:
+        lighthouse.shutdown()
+    assert_rank_states_equal(results)
+    assert injectors[1].count == 2
+
+
+def test_quorum_timeout():
+    """start_quorum with a tiny deadline on an unformable quorum returns a
+    TimeoutError quickly (manager_integ_test.py:325-368 analogue)."""
+    import time
+
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)  # never forms
+    store = StoreServer()
+    manager = None
+    try:
+        manager = Manager(
+            collectives=CollectivesTcp(timeout=timedelta(seconds=5)),
+            load_state_dict=lambda s: None,
+            state_dict=lambda: {},
+            min_replica_size=2,
+            replica_id="solo",
+            store_addr=store.address(),
+            rank=0,
+            world_size=1,
+            lighthouse_addr=lighthouse.address(),
+            connect_timeout=timedelta(seconds=5),
+        )
+        t0 = time.perf_counter()
+        manager.start_quorum(timeout=timedelta(milliseconds=100))
+        with pytest.raises(TimeoutError):
+            manager.wait_quorum()
+        assert time.perf_counter() - t0 < 2.0
+    finally:
+        if manager is not None:
+            manager.shutdown(wait=False)
+        store.shutdown()
+        lighthouse.shutdown()

@@ -88,8 +88,12 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
         self._header: Optional[bytes] = None
         self._buffers: List[np.ndarray] = []
         self._groups: List[List[int]] = []
-        # serving starts disallowed: readers block until first staging
+        # serving starts disallowed: readers block until first staging.
+        # _allowed tracks whether the write lock is currently released (the
+        # serving window is open); only the manager's quorum/commit path
+        # flips it, and that path is single-threaded by the Manager.
         self._lock.w_acquire()
+        self._allowed = False
 
         transport = self
 
@@ -100,6 +104,10 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
                 pass
 
             def do_GET(self) -> None:
+                # bound socket writes so one stalled healing peer can't hold
+                # the read lock forever (which would block the next
+                # disallow_checkpoint and fail should_commit on this side)
+                self.connection.settimeout(transport._timeout.total_seconds())
                 try:
                     transport._lock.r_acquire()
                 except TimeoutError:
@@ -135,7 +143,7 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
                     self.end_headers()
                     for part in payload:
                         self.wfile.write(part)
-                except BrokenPipeError:
+                except (BrokenPipeError, socket.timeout):
                     pass
                 except Exception as e:  # noqa: BLE001 — report to the peer
                     logger.exception("checkpoint GET failed")
@@ -179,6 +187,10 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: T, timeout: timedelta
     ) -> None:
+        # reclaim the write lock if a previous window is still open (e.g. a
+        # step aborted before should_commit ran disallow_checkpoint), so
+        # staging never races active GET streams
+        self.disallow_checkpoint()
         with _timed("staging checkpoint"):
             header, buffers = flatten_state(state_dict)
         self._header = header
@@ -189,10 +201,12 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
         )
         self._step = step
         self._lock.w_release()  # open the serving window
+        self._allowed = True
 
     def disallow_checkpoint(self) -> None:
-        if not self._lock.w_locked():
+        if self._allowed:
             self._lock.w_acquire()
+            self._allowed = False
 
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: timedelta
@@ -211,6 +225,14 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
 
         with urllib.request.urlopen(f"{base}/metadata", timeout=secs) as resp:
             header, groups = pickle.loads(resp.read())
+        if not groups:
+            # sender staged unchunked (its num_chunks=0 wins over ours)
+            with _timed("fetching full checkpoint"), urllib.request.urlopen(
+                f"{base}/full", timeout=secs
+            ) as resp:
+                from torchft_tpu.checkpointing.serialization import load_state
+
+                return load_state(resp)
         _, infos = pickle.loads(header)
         arr_infos = [i for i in infos if i[0] == "arr"]
         buffers: List[Optional[np.ndarray]] = [None] * len(arr_infos)

@@ -58,6 +58,13 @@ def _to_host(leaf: Any) -> np.ndarray:
     return np.ascontiguousarray(arr)
 
 
+def to_host_tree(tree: Any) -> Any:
+    """Pull every array leaf of a pytree to a contiguous host buffer (the
+    shared device→host step used by gradient averaging, LocalSGD backups and
+    checkpoint staging)."""
+    return _tree_util().tree_map(_to_host, tree)
+
+
 def as_bytes(arr: np.ndarray) -> memoryview:
     """Byte view that also works for ml_dtypes arrays (bfloat16 etc.), whose
     buffers plain ``memoryview(...)`` rejects."""

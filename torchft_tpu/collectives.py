@@ -227,6 +227,10 @@ class CollectivesTcp(Collectives):
         self._teardown()
         self._rank = rank
         self._world = world_size
+        # Tags order ops SPMD-style, so every member must restart the
+        # sequence together; configure() is that barrier (a rejoining
+        # replica starts at 0 while survivors would otherwise keep counting).
+        self._op_seq = 0
         self._generation += 1
         gen = self._generation
         if world_size == 1:

@@ -1,0 +1,76 @@
+"""Fault-tolerant data sharding — the DistributedSampler analogue.
+
+Reference: torchft/data.py:24-77. Shards the dataset over a virtual grid of
+``num_replica_groups × num_replicas`` workers: this worker takes global
+shard ``rank + num_replicas * replica_group`` of
+``num_replicas * num_replica_groups``. Deliberately lossy on failure: if a
+replica group dies, its shard simply isn't visited this epoch — for
+pretraining-scale corpora that bias is negligible and it keeps recovery
+stateless (same design call as the reference's docstring).
+
+Torch-free iterable; also usable as a ``torch.utils.data`` sampler since it
+just yields indices.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+__all__ = ["DistributedSampler"]
+
+
+class DistributedSampler:
+    def __init__(
+        self,
+        dataset_len: int,
+        replica_group: int,
+        num_replica_groups: int,
+        rank: int = 0,
+        num_replicas: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ) -> None:
+        """
+        Args:
+            dataset_len: number of examples (or pass a sized dataset's len)
+            replica_group: which fault-tolerance replica group this is
+            num_replica_groups: total replica groups in the job
+            rank: local rank within the replica group
+            num_replicas: local world size within the replica group
+        """
+        self._dataset_len = dataset_len
+        self._global_rank = rank + num_replicas * replica_group
+        self._global_world = num_replicas * num_replica_groups
+        self._shuffle = shuffle
+        self._seed = seed
+        self._drop_last = drop_last
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed shuffling per epoch (all workers must agree)."""
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        if self._drop_last:
+            return self._dataset_len // self._global_world
+        return (
+            self._dataset_len + self._global_world - 1
+        ) // self._global_world
+
+    def __iter__(self) -> Iterator[int]:
+        if self._shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            order = rng.permutation(self._dataset_len)
+        else:
+            order = np.arange(self._dataset_len)
+        target = len(self) * self._global_world
+        if self._drop_last:
+            order = order[:target]
+        else:
+            # pad (tiling as needed) to a grid multiple so every worker
+            # sees exactly len(self) indices and replicas stay in lockstep
+            order = np.resize(order, target)
+        yield from order[self._global_rank :: self._global_world].tolist()

@@ -1,0 +1,52 @@
+"""Standalone lighthouse CLI — ``python -m torchft_tpu.lighthouse``.
+
+The ``torchft_lighthouse`` binary analogue (reference
+src/bin/lighthouse.rs:10-23, CLI flags at src/lighthouse.rs:66-103). The
+same server also ships as a native executable (``native/tft_lighthouse``)
+for lighthouse-only boxes with no Python.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="torchft-tpu lighthouse: quorum coordinator + dashboard"
+    )
+    parser.add_argument("--bind", default="[::]:29510", help="host:port to bind")
+    parser.add_argument(
+        "--min_replicas", type=int, required=True,
+        help="minimum replica groups required to form a quorum",
+    )
+    parser.add_argument("--join_timeout_ms", type=int, default=60000)
+    parser.add_argument("--quorum_tick_ms", type=int, default=100)
+    parser.add_argument("--heartbeat_timeout_ms", type=int, default=5000)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    from torchft_tpu.coordination import LighthouseServer
+
+    server = LighthouseServer(
+        bind=args.bind,
+        min_replicas=args.min_replicas,
+        join_timeout_ms=args.join_timeout_ms,
+        quorum_tick_ms=args.quorum_tick_ms,
+        heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+    )
+    logging.info("lighthouse listening on %s (dashboard at the same address)",
+                 server.address())
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""LocalSGD and DiLoCo — communication-reduced outer-loop synchronization.
+
+Reference: torchft/local_sgd.py. LocalSGD (arxiv 1805.09767) runs
+``sync_every`` purely-local optimizer steps, then averages *parameters*
+across replica groups; DiLoCo (arxiv 2311.08105) instead averages
+*pseudogradients* (the parameter delta since the last sync) and feeds them
+to an outer optimizer.
+
+Functional JAX shape: instead of hooking a torch optimizer, the caller
+threads the params pytree through ``step()`` after every inner update::
+
+    lsgd = LocalSGD(manager, sync_every=32)
+    lsgd.save(params)                       # backup before the first step
+    for batch in data:
+        params, opt_state = inner_step(params, opt_state, batch)
+        params = lsgd.step(params)          # averages every sync_every calls
+
+A host-side backup of the last synced params makes failed syncs safe: if
+the quorum doesn't commit, ``step`` returns the backup and the
+``sync_every`` local steps are discarded (same guarantee as the reference).
+
+DiLoCo note: this implementation uses the paper's pseudogradient sign
+``backup − local`` (so the outer optimizer *descends* toward the inner
+progress). The reference computes ``local − backup`` (local_sgd.py:211-215),
+which inverts the outer step direction; we keep the paper semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from torchft_tpu.checkpointing.serialization import to_host_tree as _to_host
+from torchft_tpu.ddp import allreduce_gradients
+from torchft_tpu.manager import Manager
+
+__all__ = ["LocalSGD", "DiLoCo"]
+
+
+class LocalSGD:
+    """Parameter averaging every ``sync_every`` local steps."""
+
+    def __init__(self, manager: Manager, sync_every: int) -> None:
+        assert sync_every >= 1, "sync_every must be >= 1"
+        self._manager = manager
+        self._sync_every = sync_every
+        self._local_step = 0
+        self._backup: Optional[Any] = None
+
+    def save(self, params: Any) -> None:
+        """Snapshot ``params`` to host as the restore point."""
+        self._backup = _to_host(params)
+
+    def step(self, params: Any) -> Any:
+        """Count one local optimizer step; every ``sync_every`` calls run a
+        fault-tolerant sync and return the post-sync params."""
+        if self._backup is None:
+            raise RuntimeError("call save(params) before the first step")
+        self._local_step += 1
+        if self._local_step >= self._sync_every:
+            params = self.sync(params)
+            self._local_step = 0
+        return params
+
+    def sync(self, params: Any) -> Any:
+        self._manager.start_quorum()
+        return self._perform_sync(params)
+
+    def _perform_sync(self, params: Any) -> Any:
+        # allreduce_gradients averages any pytree — here, the params
+        averaged = allreduce_gradients(self._manager, params)
+        if self._manager.should_commit():
+            self._backup = averaged
+            return averaged
+        return self._backup  # discard the local steps
+
+
+class DiLoCo(LocalSGD):
+    """Pseudogradient averaging with an outer optimizer.
+
+    ``outer_tx`` is an optax transformation (the paper uses SGD with
+    Nesterov momentum). Requires ``use_async_quorum=False``: the outer step
+    must start from a fully-healed state or replicas diverge
+    (local_sgd.py:195-199)."""
+
+    def __init__(self, manager: Manager, outer_tx, sync_every: int) -> None:
+        if manager._use_async_quorum:
+            raise ValueError(
+                "DiLoCo requires synchronous quorum; construct the Manager "
+                "with use_async_quorum=False"
+            )
+        super().__init__(manager, sync_every)
+        self._outer_tx = outer_tx
+        self._outer_state: Optional[Any] = None
+
+    def save(self, params: Any) -> None:
+        super().save(params)
+        if self._outer_state is None:
+            self._outer_state = self._outer_tx.init(self._backup)
+
+    def _perform_sync(self, params: Any) -> Any:
+        import jax
+        import optax
+
+        assert self._backup is not None and self._outer_state is not None
+        local = _to_host(params)
+        # paper-sign pseudogradient: descend from the backup toward the
+        # averaged inner progress
+        pseudograd = jax.tree_util.tree_map(np.subtract, self._backup, local)
+        pseudograd = allreduce_gradients(self._manager, pseudograd)
+
+        if not self._manager.should_commit():
+            return self._backup
+
+        updates, self._outer_state = self._outer_tx.update(
+            pseudograd, self._outer_state, self._backup
+        )
+        new_params = optax.apply_updates(self._backup, updates)
+        self._backup = _to_host(new_params)
+        return new_params
+
+    def outer_state(self) -> Any:
+        return self._outer_state

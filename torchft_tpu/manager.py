@@ -213,6 +213,8 @@ class Manager:
             self._manager.shutdown()
         self._executor.shutdown(wait=wait)
         self._collectives.shutdown()
+        self._client.close()
+        self._store.close()
 
     # ------------------------------------------------------------------
     # quorum

@@ -1,0 +1,109 @@
+"""Commit-gated optimizer — the OptimizerWrapper analogue for optax.
+
+The reference wraps a torch optimizer so ``zero_grad()`` starts the quorum
+and ``step()`` only applies when the group votes to commit
+(torchft/optim.py:48-55). Torch mutates the model in place, which is also
+how a healed checkpoint reaches the optimizer mid-step; in JAX the state is
+immutable pytrees, so this wrapper *owns* them — recovery (which lands via
+the manager's ``load_state_dict`` callback inside ``should_commit``)
+replaces the internal pytrees before the update applies::
+
+    opt = ManagedOptimizer(manager, optax.adam(1e-3))
+    opt.init(params)                      # registers state fns on the manager
+    for batch in data:
+        opt.begin_step()                  # zero_grad() analogue: start quorum
+        loss, grads = value_and_grad_fn(opt.params, batch)
+        opt.step(grads)                   # average + commit gate + update
+
+``step`` averages gradients across replica groups through the Manager and
+applies the optax update only if ``should_commit()`` — otherwise the state
+is untouched and the step is discarded.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from torchft_tpu.ddp import allreduce_gradients
+from torchft_tpu.manager import Manager
+
+__all__ = ["ManagedOptimizer"]
+
+
+class ManagedOptimizer:
+    def __init__(self, manager: Manager, tx, register_state: bool = True) -> None:
+        """``tx`` is an ``optax.GradientTransformation``. With
+        ``register_state`` (default) ``init`` wires this wrapper's
+        state_dict/load_state_dict into the manager so live recovery
+        restores params and optimizer state automatically; pass False if the
+        user snapshot covers more than the optimizer (then include
+        ``opt.state_dict()`` in it)."""
+        self._manager = manager
+        self._tx = tx
+        self._register_state = register_state
+        self._apply = None
+        self._params: Optional[Any] = None
+        self._opt_state: Optional[Any] = None
+
+    # -- state --
+
+    @property
+    def params(self) -> Any:
+        assert self._params is not None, "call init(params) first"
+        return self._params
+
+    @property
+    def opt_state(self) -> Any:
+        return self._opt_state
+
+    def init(self, params: Any) -> None:
+        self._params = params
+        self._opt_state = self._tx.init(params)
+        if self._register_state:
+            self._manager.set_state_dict_fns(self.load_state_dict, self.state_dict)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"params": self._params, "opt_state": self._opt_state}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._params = state["params"]
+        self._opt_state = state["opt_state"]
+
+    # -- step --
+
+    def begin_step(self, allow_heal: bool = True, shrink_only: bool = False) -> None:
+        """Start the (async) quorum — call before the forward pass so the
+        RPC overlaps compute (the reference hooks this into zero_grad)."""
+        self._manager.start_quorum(allow_heal=allow_heal, shrink_only=shrink_only)
+
+    def step(self, grads: Any, average: bool = True) -> Any:
+        """Average ``grads`` across replica groups, then apply the update
+        iff the step commits. Returns the current params (healed and/or
+        updated). Pass ``average=False`` if the gradients already went
+        through ``manager.allreduce``."""
+        if average:
+            grads = allreduce_gradients(self._manager, grads)
+        committed = self._manager.should_commit()
+        # should_commit may have healed: self._params now reflects the
+        # recovered state; the gradient applied to it is the participants'
+        # average (a healing replica contributed zeros)
+        if committed:
+            self._params, self._opt_state = self._apply_update(
+                self._params, self._opt_state, grads
+            )
+        return self._params
+
+    def _apply_update(self, params: Any, opt_state: Any, grads: Any):
+        if self._apply is None:
+            import jax
+            import optax
+
+            tx = self._tx
+
+            @jax.jit
+            def apply(params, opt_state, grads):
+                updates, new_state = tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), new_state
+
+            self._apply = apply
+        return self._apply(params, opt_state, grads)
