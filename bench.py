@@ -1,0 +1,125 @@
+"""Headline benchmark: fault-tolerant transformer training throughput.
+
+Runs the full FT loop — real C++ lighthouse + manager, quorum per step,
+commit vote per step — around the jitted bf16 transformer train step on
+whatever accelerator is attached (TPU under the driver; CPU works too).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is 1.0 by definition: the reference (Krishn1412/torchft)
+publishes no performance numbers (BASELINE.md), so the measured value IS
+the baseline being established.
+"""
+
+import json
+import logging
+import os
+import sys
+import time
+from datetime import timedelta
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+logging.basicConfig(level=logging.WARNING)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchft_tpu.collectives import CollectivesTcp
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.models.transformer import TransformerConfig
+    from torchft_tpu.parallel.mesh import MeshConfig, make_mesh
+    from torchft_tpu.parallel.train_step import TrainStep
+    from torchft_tpu.store import StoreServer
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.devices()[0].platform != "cpu"
+
+    cfg = TransformerConfig(
+        vocab_size=32000,
+        d_model=512,
+        n_layers=8,
+        n_heads=8,
+        head_dim=64,
+        d_ff=1408,
+        dtype=jnp.bfloat16,
+    )
+    batch, seq = (8, 1024) if on_tpu else (4, 128)
+    steps, warmup = (20, 3) if on_tpu else (5, 1)
+
+    mesh = make_mesh(MeshConfig(dp=1))  # single chip; FT axis is host-side
+    ts = TrainStep(cfg, optax.adamw(3e-4), mesh)
+    params = ts.init_params(jax.random.PRNGKey(0))
+    opt_state = ts.init_opt(params)
+
+    # full FT control plane, 1 replica group
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=1)
+    store = StoreServer()
+    manager = Manager(
+        collectives=CollectivesTcp(timeout=timedelta(seconds=30)),
+        load_state_dict=lambda s: None,
+        state_dict=lambda: {},
+        min_replica_size=1,
+        replica_id="bench",
+        store_addr=store.address(),
+        rank=0,
+        world_size=1,
+        lighthouse_addr=lighthouse.address(),
+    )
+
+    rng = np.random.default_rng(0)
+    tokens = ts.shard_batch(
+        jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    )
+
+    def ft_step(params, opt_state):
+        # reference-faithful ordering: grads, then the commit vote gates the
+        # optimizer step (manager.py:546-599). The split grads/apply pair is
+        # also what makes rollback safe: apply() donates the old params only
+        # after the group committed.
+        manager.start_quorum()
+        loss, grads = ts.grads(params, tokens)
+        if manager.should_commit():
+            params, opt_state = ts.apply(params, opt_state, grads)
+        return loss, params, opt_state
+
+    try:
+        for _ in range(warmup):
+            loss, params, opt_state = ft_step(params, opt_state)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, params, opt_state = ft_step(params, opt_state)
+        # a host transfer is the only reliable completion fence on the
+        # tunneled TPU backend (block_until_ready returns early there);
+        # the final loss depends on the whole step chain
+        float(loss)
+        elapsed = time.perf_counter() - t0
+    finally:
+        manager.shutdown(wait=False)
+        store.shutdown()
+        lighthouse.shutdown()
+
+    steps_per_sec = steps / elapsed
+    tokens_per_sec = steps_per_sec * batch * seq
+    print(
+        json.dumps(
+            {
+                "metric": "ft_transformer_train_steps_per_sec_per_chip",
+                "value": round(steps_per_sec, 4),
+                "unit": f"steps/s (bf16 d512 L8 b{batch} s{seq}; {tokens_per_sec:.0f} tok/s; full quorum+commit per step)",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

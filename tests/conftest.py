@@ -3,12 +3,18 @@ import os
 # Force JAX onto a virtual 8-device CPU mesh for all tests: multi-chip
 # sharding is validated without TPU hardware (the driver separately
 # dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The container's sitecustomize registers the TPU PJRT plugin and can win
+# over the env var; pin the platform explicitly too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # Let in-process tests exercise the kill RPC without nuking pytest.
 os.environ.setdefault("TORCHFT_TPU_SOFT_KILL", "1")

@@ -1,0 +1,23 @@
+"""Model families for the TPU-native framework.
+
+The reference wraps user-supplied torch models (CIFAR CNN in train_ddp.py,
+nn.Linear toys in tests); here the framework owns a mesh-aware model stack.
+``transformer`` is the flagship: a decoder-only LM with dp/fsdp/pp/sp/tp/ep
+shardings, dense or MoE FFNs, RoPE, RMSNorm and ring attention.
+"""
+
+from torchft_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    loss_fn,
+    forward,
+    param_specs,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "loss_fn",
+    "forward",
+    "param_specs",
+]

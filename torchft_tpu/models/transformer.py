@@ -1,0 +1,277 @@
+"""Decoder-only transformer LM — the flagship model.
+
+Pure-JAX pytree params with explicit ``PartitionSpec``s per leaf:
+
+* ``tp``  — attention heads and FFN hidden dim (megatron-style; XLA/GSPMD
+  inserts the all-reduces from the shardings, nothing manual here)
+* ``sp``  — sequence axis via ring attention (ops/attention.py)
+* ``pp``  — layer stages via the microbatched ppermute ring
+  (parallel/pipeline.py); stage params carry a leading [pp, Lp] axis
+* ``ep``  — MoE experts (top-2 capacity dispatch, ops/layers.py)
+* ``dp``/``fsdp`` — batch / parameter sharding
+
+Layers within a stage run under ``lax.scan`` (one compile per stage, not
+per layer) with ``jax.checkpoint`` rematerialization — compile time and
+HBM both scale O(1) in depth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from torchft_tpu.ops.attention import attention, ring_attention, ring_attention_local
+from torchft_tpu.ops.layers import moe_dispatch, rms_norm, rotary_embed, swiglu
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "param_specs",
+    "forward",
+    "loss_fn",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    head_dim: int = 64
+    d_ff: int = 1408
+    n_experts: int = 0  # 0 => dense FFN
+    capacity_factor: float = 1.25
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16  # compute dtype (MXU-native)
+    remat: bool = True
+    pp: int = 1  # pipeline stages; n_layers % pp == 0
+    microbatches: int = 0  # 0 => = pp
+
+    @property
+    def layers_per_stage(self) -> int:
+        assert self.n_layers % max(self.pp, 1) == 0
+        return self.n_layers // max(self.pp, 1)
+
+    @property
+    def qkv_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+
+def init_params(rng, cfg: TransformerConfig) -> Dict[str, Any]:
+    """Params as a pytree of float32 numpy-backed arrays; leading [pp, Lp]
+    axes on per-layer tensors."""
+    keys = jax.random.split(rng, 16)
+    d, qkv, f = cfg.d_model, cfg.qkv_dim, cfg.d_ff
+    lp, pp = cfg.layers_per_stage, max(cfg.pp, 1)
+
+    def dense(key, *shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32) * (fan_in**-0.5)
+        )
+
+    layers: Dict[str, Any] = {
+        "ln1": jnp.ones((pp, lp, d), jnp.float32),
+        "ln2": jnp.ones((pp, lp, d), jnp.float32),
+        "wq": dense(keys[0], pp, lp, d, qkv, fan_in=d),
+        "wk": dense(keys[1], pp, lp, d, qkv, fan_in=d),
+        "wv": dense(keys[2], pp, lp, d, qkv, fan_in=d),
+        "wo": dense(keys[3], pp, lp, qkv, d, fan_in=qkv),
+    }
+    if cfg.n_experts:
+        e = cfg.n_experts
+        layers.update(
+            router=dense(keys[4], pp, lp, d, e, fan_in=d),
+            w_gate=dense(keys[5], pp, lp, e, d, f, fan_in=d),
+            w_in=dense(keys[6], pp, lp, e, d, f, fan_in=d),
+            w_out=dense(keys[7], pp, lp, e, f, d, fan_in=f),
+        )
+    else:
+        layers.update(
+            w_gate=dense(keys[5], pp, lp, d, f, fan_in=d),
+            w_in=dense(keys[6], pp, lp, d, f, fan_in=d),
+            w_out=dense(keys[7], pp, lp, f, d, fan_in=f),
+        )
+    return {
+        "embed": dense(keys[8], cfg.vocab_size, d, fan_in=1.0),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "out": dense(keys[9], d, cfg.vocab_size, fan_in=d),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpec per leaf (matches init_params structure)."""
+    row, col = P("pp", None, "fsdp", "tp"), P("pp", None, "tp", "fsdp")
+    layers: Dict[str, Any] = {
+        "ln1": P("pp", None, None),
+        "ln2": P("pp", None, None),
+        "wq": row,
+        "wk": row,
+        "wv": row,
+        "wo": col,
+    }
+    if cfg.n_experts:
+        layers.update(
+            router=P("pp", None, "fsdp", None),
+            w_gate=P("pp", None, "ep", "fsdp", "tp"),
+            w_in=P("pp", None, "ep", "fsdp", "tp"),
+            w_out=P("pp", None, "ep", "tp", "fsdp"),
+        )
+    else:
+        layers.update(w_gate=row, w_in=row, w_out=col)
+    return {
+        "embed": P("tp", "fsdp"),
+        "layers": layers,
+        "final_norm": P(None),
+        "out": P("fsdp", "tp"),
+    }
+
+
+def _act_spec(sp_manual: bool = False) -> P:
+    # inside a manual-sp region the sequence axis is already local; only
+    # auto axes may appear in constraints
+    return P(("dp", "fsdp"), None, None) if sp_manual else P(("dp", "fsdp"), "sp", None)
+
+
+def _constrain(x: jnp.ndarray, spec: P) -> jnp.ndarray:
+    """with_sharding_constraint that no-ops when there is no context mesh
+    (single-chip / unsharded use)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def _ffn_dense(lp: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+    return swiglu(x, lp["w_gate"], lp["w_in"], lp["w_out"])
+
+
+def _ffn_moe(lp: Dict[str, Any], x: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
+    b, s, d = x.shape
+    g = b * s
+    tokens = x.reshape(g, d)
+    gates = jax.nn.softmax(
+        (tokens @ lp["router"]).astype(jnp.float32), axis=-1
+    ).astype(x.dtype)
+    capacity = max(
+        1, int(np.ceil(2 * g / cfg.n_experts * cfg.capacity_factor))
+    )
+    dispatch, combine = moe_dispatch(gates, capacity)
+    # [G,E,C] x [G,D] -> [E,C,D]: the all-to-all over `ep` falls out of the
+    # expert-axis sharding on the einsum operands
+    expert_in = jnp.einsum("gec,gd->ecd", dispatch, tokens)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"])) * jnp.einsum(
+        "ecd,edf->ecf", expert_in, lp["w_in"]
+    )
+    expert_out = jnp.einsum("ecf,efd->ecd", h, lp["w_out"])
+    out = jnp.einsum("gec,ecd->gd", combine, expert_out)
+    return out.reshape(b, s, d)
+
+
+def _make_layer_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
+    sp_size = mesh.shape.get("sp", 1) if mesh is not None else 1
+
+    def layer_fn(x: jnp.ndarray, lp: Dict[str, Any]) -> jnp.ndarray:
+        x = _constrain(x, _act_spec(sp_manual))
+        h = rms_norm(x, lp["ln1"], cfg.norm_eps)
+        b, s, _ = h.shape  # s is the sp-local block inside a manual region
+        if sp_manual and sp_size > 1:
+            positions = jax.lax.axis_index("sp") * s + jnp.arange(s)
+        else:
+            positions = jnp.arange(s)
+        q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        q = rotary_embed(q, positions, cfg.rope_theta)
+        k = rotary_embed(k, positions, cfg.rope_theta)
+        if sp_size > 1 and sp_manual:
+            att = ring_attention_local(q, k, v, sp_size, causal=True)
+        elif sp_size > 1:
+            att = ring_attention(q, k, v, mesh, causal=True)
+        else:
+            att = attention(q, k, v, causal=True)
+        x = x + att.reshape(b, s, cfg.qkv_dim) @ lp["wo"]
+
+        h = rms_norm(x, lp["ln2"], cfg.norm_eps)
+        if cfg.n_experts:
+            x = x + _ffn_moe(lp, h, cfg)
+        else:
+            x = x + _ffn_dense(lp, h)
+        return _constrain(x, _act_spec(sp_manual))
+
+    return layer_fn
+
+
+def _make_stage_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
+    layer_fn = _make_layer_fn(cfg, mesh, sp_manual)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def stage_fn(stage_params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+        # stage_params leaves: [Lp, ...]; scan over the layer axis
+        def body(x, lp):
+            return layer_fn(x, lp), ()
+
+        x, _ = jax.lax.scan(body, x, stage_params)
+        return x
+
+    return stage_fn
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    mesh=None,
+) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, V] (compute in cfg.dtype,
+    logits in float32)."""
+    from torchft_tpu.parallel.pipeline import pipeline_forward
+
+    b, s = tokens.shape
+    dt = cfg.dtype
+    x = jnp.take(params["embed"].astype(dt), tokens, axis=0)
+    x = _constrain(x, _act_spec())
+
+    layers = jax.tree_util.tree_map(lambda a: a.astype(dt), params["layers"])
+
+    pp = max(cfg.pp, 1)
+    if pp == 1:
+        stage_fn = _make_stage_fn(cfg, mesh, sp_manual=False)
+        x = stage_fn(jax.tree_util.tree_map(lambda a: a[0], layers), x)
+    else:
+        # inside the pipeline's manual region the sp axis is manual too
+        # (Shardy forbids nested manual regions)
+        sp_manual = mesh is not None and mesh.shape.get("sp", 1) > 1
+        stage_fn = _make_stage_fn(cfg, mesh, sp_manual=sp_manual)
+        m = cfg.microbatches or pp
+        assert b % m == 0, f"batch {b} must divide into {m} microbatches"
+        x_mb = x.reshape(m, b // m, s, -1)
+        x_mb = pipeline_forward(layers, x_mb, stage_fn, mesh)
+        x = x_mb.reshape(b, s, -1)
+
+    x = rms_norm(x, params["final_norm"].astype(dt), cfg.norm_eps)
+    return (x @ params["out"].astype(dt)).astype(jnp.float32)
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    mesh=None,
+) -> jnp.ndarray:
+    """Next-token cross entropy; position S-1 is unsupervised (targets are
+    tokens shifted left; same [B, S] shape keeps sp sharding aligned)."""
+    logits = forward(params, tokens, cfg, mesh)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    mask = jnp.ones_like(nll).at[:, -1].set(0.0)
+    return jnp.sum(nll * mask) / jnp.sum(mask)

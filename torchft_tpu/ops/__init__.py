@@ -1,0 +1,12 @@
+"""TPU compute ops: attention (plain, ring/sequence-parallel, pallas
+flash), normalization, rotary embeddings, and MoE dispatch.
+
+The reference has no compute ops of its own (it wraps torch modules); this
+package exists because the TPU-native framework owns its training stack.
+Everything is jit-/AD-compatible and mesh-aware.
+"""
+
+from torchft_tpu.ops.attention import attention, ring_attention
+from torchft_tpu.ops.layers import rms_norm, rotary_embed, swiglu
+
+__all__ = ["attention", "ring_attention", "rms_norm", "rotary_embed", "swiglu"]

@@ -1,0 +1,146 @@
+"""Attention: plain softmax attention and ring attention for sequence
+parallelism.
+
+Ring attention (Liu et al., arxiv 2310.01889) is the long-context mechanism
+the reference lacks entirely (SURVEY.md §5.7): the sequence axis is sharded
+over the ``sp`` mesh axis; each device holds a Q block and streams K/V
+blocks around the ring via ``ppermute``, maintaining a numerically-stable
+running softmax (the flash-attention recurrence), so attention memory is
+O(S/sp) per chip and the K/V transfer overlaps compute on the ICI ring.
+
+Implemented with ``lax.scan`` (reverse-differentiable, unlike fori_loop)
+inside a partial-manual ``shard_map`` over only the ``sp`` axis — dp/tp
+stay under GSPMD so the same code serves every mesh layout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["attention", "ring_attention", "ring_attention_local"]
+
+_NEG_INF = -1e30
+
+
+def _causal_mask(q_pos: jnp.ndarray, k_pos: jnp.ndarray) -> jnp.ndarray:
+    """[Sq, Sk] True where k may attend (k_pos <= q_pos)."""
+    return k_pos[None, :] <= q_pos[:, None]
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Plain attention. q/k/v: [B, S, H, Dh] -> [B, S, H, Dh]."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        s = q.shape[1]
+        pos = jnp.arange(s)
+        scores = jnp.where(_causal_mask(pos, pos)[None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    sp_size: int,
+    causal: bool = True,
+    axis: str = "sp",
+) -> jnp.ndarray:
+    """Per-shard ring attention body: q/k/v are the local [B, Sl, H, Dh]
+    blocks. Call directly when already inside a manual region over ``sp``
+    (e.g. the pp pipeline — Shardy forbids nesting another shard_map);
+    otherwise use :func:`ring_attention`, which wraps this in its own
+    shard_map."""
+    my = jax.lax.axis_index(axis)
+    b, sl, h, dh = q.shape
+    scale = dh**-0.5
+    q_pos = my * sl + jnp.arange(sl)
+
+    qf = q.astype(jnp.float32)
+
+    def step(carry, _):
+        # k/v blocks rotate right each step, so at step t we hold the block
+        # originally owned by shard (my - t) % sp
+        acc, m, l, k_cur, v_cur, owner = carry
+        k_pos = owner * sl + jnp.arange(sl)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32))
+        scores = scores * scale
+        if causal:
+            mask = _causal_mask(q_pos, k_pos)
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+
+        blk_max = jnp.max(scores, axis=-1)  # [B,H,Sl]
+        new_m = jnp.maximum(m, blk_max)
+        # rescale previous accumulator, add this block's contribution
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(scores - new_m[..., None])  # [B,H,Sq,Sk]
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        l = l * correction + jnp.sum(p, axis=-1)
+
+        perm = [(r, (r + 1) % sp_size) for r in range(sp_size)]
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        owner = (owner - 1) % sp_size
+        return (acc, new_m, l, k_nxt, v_nxt, owner), ()
+
+    # Initial accumulators must carry the same varying-manual-axes type as
+    # the scan outputs (jax>=0.9 VMA typing). Deriving them from q (zeroed,
+    # XLA folds it) inherits q's full varying set — which includes any
+    # *other* manual axes active when ring attention is nested inside e.g.
+    # the pp pipeline, not just 'sp'.
+    zero_bhq = jnp.einsum("bqhd->bhq", qf) * 0.0
+    acc0 = jnp.einsum("bqhd->bhqd", qf) * 0.0
+    m0 = zero_bhq + _NEG_INF
+    l0 = zero_bhq
+    (acc, m, l, _, _, _), _ = jax.lax.scan(
+        step, (acc0, m0, l0, k, v, my), None, length=sp_size
+    )
+    # rows with no visible keys (can't happen with causal self-attn) guard
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh,
+    causal: bool = True,
+    axis: str = "sp",
+) -> jnp.ndarray:
+    """Sequence-parallel attention over mesh axis ``axis``.
+
+    q/k/v: [B, S, H, Dh] with S sharded over ``axis``; other axes remain
+    GSPMD-managed. Falls back to plain attention when the axis is size 1.
+    """
+    sp_size = mesh.shape[axis]
+    if sp_size == 1:
+        return attention(q, k, v, causal=causal)
+
+    body = functools.partial(
+        ring_attention_local, sp_size=sp_size, causal=causal, axis=axis
+    )
+    spec = P(None, axis, None, None)
+    # mesh is intentionally not forwarded: inside another partial-manual
+    # region (e.g. the pp pipeline) the context mesh already has those axes
+    # marked Manual, and shard_map requires an exact match — the ambient
+    # mesh is always the right one. `mesh` is only used for sp_size above.
+    return jax.shard_map(
+        body,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis},
+    )(q, k, v)

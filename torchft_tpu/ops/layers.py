@@ -1,0 +1,91 @@
+"""Building-block layers: RMSNorm, rotary embeddings, SwiGLU, MoE dispatch.
+
+All pure functions over explicit params — XLA fuses the elementwise chains
+into the adjacent matmuls, so there is nothing to hand-schedule here
+(pallas is reserved for attention, where fusion across the softmax is
+beyond XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["rms_norm", "rotary_embed", "swiglu", "moe_dispatch"]
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(dtype) * weight
+
+
+def rotary_embed(
+    x: jnp.ndarray, positions: jnp.ndarray, theta: float = 10000.0
+) -> jnp.ndarray:
+    """RoPE. x: [B, S, H, Dh], positions: [S] (global positions, so the
+    same code is correct under sequence sharding)."""
+    dh = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, dh, 2, dtype=jnp.float32) / dh)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, Dh/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out1 = xf1 * cos - xf2 * sin
+    out2 = xf1 * sin + xf2 * cos
+    out = jnp.stack([out1, out2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jnp.ndarray, w_gate: jnp.ndarray, w_in: jnp.ndarray, w_out: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU FFN: (silu(x@w_gate) * (x@w_in)) @ w_out."""
+    h = jax.nn.silu(x @ w_gate) * (x @ w_in)
+    return h @ w_out
+
+
+def moe_dispatch(
+    gates: jnp.ndarray, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-2 token→expert dispatch with capacity (mesh-tensorflow style —
+    static shapes, einsum-friendly, so XLA turns the expert axis sharding
+    into an all-to-all over ``ep``).
+
+    gates: [G, E] softmax router probabilities for G tokens.
+    Returns (dispatch [G, E, C] one-hot-ish float, combine [G, E, C]).
+    Tokens over capacity are dropped (standard MoE behavior).
+    """
+    g, e = gates.shape
+
+    # top-1 choice
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, e, dtype=gates.dtype)  # [G, E]
+    # top-2: mask out the first choice
+    gates2 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=gates.dtype)
+
+    # position of each token within its expert's buffer (first-come order)
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1  # [G, E], 0-indexed
+    # second choices queue behind all first choices
+    pos2 = (jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0)[None, :]) * mask2
+
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    # renormalize the two gate values over the kept choices
+    g1 = jnp.sum(gates * keep1, axis=-1)
+    g2 = jnp.sum(gates * keep2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    c_range = jnp.arange(capacity, dtype=gates.dtype)
+    onehot_pos1 = (pos1[..., None] == c_range) * keep1[..., None]  # [G,E,C]
+    onehot_pos2 = (pos2[..., None] == c_range) * keep2[..., None]
+
+    dispatch = onehot_pos1 + onehot_pos2
+    combine = onehot_pos1 * g1[:, None, None] + onehot_pos2 * g2[:, None, None]
+    return dispatch, combine

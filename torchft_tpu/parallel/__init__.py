@@ -1,0 +1,25 @@
+"""Intra-replica-group parallelism — the TPU-native data plane.
+
+The reference's intra-group story is "bring your own torch parallelism"
+(FSDP2/DTensor composed with the FT replicate axis via ManagedDeviceMesh,
+process_group.py:1332-1606). On TPU the idiomatic equivalent is richer: one
+``jax.sharding.Mesh`` over the group's chips with named axes
+
+    dp    data parallel (batch)           — ICI all-reduce of grads
+    fsdp  param/optimizer sharding (zero) — all-gather weights per layer
+    pp    pipeline stages                 — microbatched ppermute ring
+    sp    sequence/context parallel       — ring attention over seq blocks
+    tp    tensor parallel (heads/ffn)     — XLA-inserted collectives
+    ep    expert parallel (MoE experts)   — all-to-all token dispatch
+
+XLA's GSPMD inserts the collectives from sharding annotations; only the
+manual-overlap paths (ring attention, pipeline ring) use shard_map. The
+fault-tolerance replica axis stays *outside* this mesh (host-side managed
+collectives), so quorum membership changes never recompile the step.
+"""
+
+from torchft_tpu.parallel.mesh import MeshConfig, make_mesh
+from torchft_tpu.parallel.pipeline import pipeline_forward
+from torchft_tpu.parallel.train_step import TrainStep
+
+__all__ = ["MeshConfig", "make_mesh", "pipeline_forward", "TrainStep"]

@@ -1,0 +1,115 @@
+"""Pipeline parallelism: a GPipe-style microbatched ring over the ``pp``
+mesh axis.
+
+The reference has no pipeline support (SURVEY.md §2.3 — PP: "No"); this is
+part of the intra-group parallelism the TPU framework owns. Design: stage
+parameters carry a leading ``[pp, ...]`` axis sharded over the ``pp`` mesh
+axis; inside a partial-manual ``shard_map`` each stage runs every tick,
+activations hop stage→stage via ``ppermute``, and microbatch m exits stage
+P-1 at tick ``m + P - 1``. The fill/drain bubble is the standard GPipe
+cost: utilization M / (M + P - 1) for M microbatches.
+
+Reverse-mode AD through the scan + ppermute gives the backward pipeline
+automatically (transposed permutes run the ring in reverse).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_forward"]
+
+
+def pipeline_forward(
+    stage_params: Any,
+    x_mb: jnp.ndarray,
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    mesh,
+    axis: str = "pp",
+    sp_axis: str = "sp",
+) -> jnp.ndarray:
+    """Run microbatches through the stage pipeline.
+
+    Args:
+        stage_params: pytree, every leaf with leading axis ``pp_size``
+            (sharded ``P(axis, ...)``)
+        x_mb: ``[M, mb, S, D]`` microbatched activations (replicated over
+            ``axis``; other mesh axes GSPMD-sharded as usual)
+        stage_fn: ``(params_for_one_stage, [mb, S, D]) -> [mb, S, D]``.
+            When the mesh has ``sp_axis`` > 1, the sequence axis is ALSO
+            manual inside this region (Shardy rejects nested manual
+            regions), so stage_fn sees the local S/sp block and must use
+            sp-local ops (ring_attention_local, local positions).
+    Returns:
+        ``[M, mb, S, D]`` outputs of the final stage (replicated over
+        ``axis`` so downstream ops don't care where they materialized).
+    """
+    pp = mesh.shape[axis]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != pp:
+            raise ValueError(
+                f"stage_params leading axis {leaf.shape[0]} != mesh {axis} "
+                f"size {pp}: the model was configured for a different "
+                f"pipeline depth than the mesh provides"
+            )
+    if pp == 1:
+        squeezed = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        return jax.vmap(lambda x: stage_fn(squeezed, x))(x_mb)
+    sp = mesh.shape.get(sp_axis, 1)
+
+    m = x_mb.shape[0]
+    ticks = m + pp - 1
+
+    def per_stage(params_local, x_all):
+        # params_local leaves: [1, ...] (this stage's slice) -> drop axis
+        params_local = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        my = jax.lax.axis_index(axis)
+        is_first = my == 0
+        is_last = my == pp - 1
+        perm = [(r, (r + 1) % pp) for r in range(pp)]
+
+        def tick(carry, t):
+            cur, outputs = carry
+            feed_idx = jnp.clip(t, 0, m - 1)
+            inp = jnp.where(
+                is_first, jax.lax.dynamic_index_in_dim(x_all, feed_idx, 0, False), cur
+            )
+            y = stage_fn(params_local, inp)
+            out_idx = t - (pp - 1)
+            ci = jnp.clip(out_idx, 0, m - 1)
+            valid = is_last & (out_idx >= 0)
+            prev = jax.lax.dynamic_index_in_dim(outputs, ci, 0, False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, y, prev), ci, 0
+            )
+            cur = jax.lax.ppermute(y, axis, perm)
+            return (cur, outputs), ()
+
+        # initial carries must be VMA-typed as varying over every manual
+        # axis the scan outputs vary over; deriving from x_all (zeroed, XLA
+        # folds it) inherits the right set, then add 'pp' which enters via
+        # axis_index/ppermute
+        cur0, out0 = jax.lax.pcast(
+            (x_all[0] * 0, x_all * 0), (axis,), to="varying"
+        )
+        (_, outputs), _ = jax.lax.scan(
+            tick, (cur0, out0), jnp.arange(ticks)
+        )
+        # only the last stage holds real outputs; replicate over pp
+        outputs = jnp.where(is_last, outputs, jnp.zeros_like(outputs))
+        return jax.lax.psum(outputs, axis)
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    manual = {axis} if sp == 1 else {axis, sp_axis}
+    act_spec = P() if sp == 1 else P(None, None, sp_axis, None)
+    # context mesh (set via jax.set_mesh) rather than an explicit one
+    return jax.shard_map(
+        per_stage,
+        in_specs=(param_specs, act_spec),
+        out_specs=act_spec,
+        axis_names=manual,
+    )(stage_params, x_mb)

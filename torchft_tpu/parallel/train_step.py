@@ -1,0 +1,95 @@
+"""Mesh-aware train step builder.
+
+Couples the flagship model to optax under jit with explicit shardings.
+Two drive modes:
+
+* ``step``  — fused grads+update, buffers donated; the single-replica-group
+  hot path (everything stays on device).
+* ``grads`` / ``apply`` — split pair for fault-tolerant cross-group
+  training: grads come to host, the Manager averages them over the elastic
+  replica axis (outside jit, so membership changes never recompile), then
+  ``apply`` updates on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchft_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    loss_fn,
+    param_specs,
+)
+
+__all__ = ["TrainStep"]
+
+
+class TrainStep:
+    def __init__(self, cfg: TransformerConfig, tx, mesh) -> None:
+        self.cfg = cfg
+        self.tx = tx
+        self.mesh = mesh
+        self._pspecs = param_specs(cfg)
+        self._param_shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), self._pspecs
+        )
+        self._batch_sharding = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+        def compute_loss(params, tokens):
+            return loss_fn(params, tokens, cfg, mesh)
+
+        self._value_and_grad = jax.jit(jax.value_and_grad(compute_loss))
+
+        def apply_updates(params, opt_state, grads):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            import optax
+
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply = jax.jit(apply_updates, donate_argnums=(0, 1))
+
+        def fused(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(compute_loss)(params, tokens)
+            new_params, opt_state = apply_updates(params, opt_state, grads)
+            return loss, new_params, opt_state
+
+        self._fused = jax.jit(fused, donate_argnums=(0, 1))
+
+    # -- state --
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        with jax.set_mesh(self.mesh):
+            params = jax.jit(
+                lambda r: init_params(r, self.cfg),
+                out_shardings=self._param_shardings,
+            )(rng)
+        return params
+
+    def init_opt(self, params) -> Any:
+        with jax.set_mesh(self.mesh):
+            return jax.jit(self.tx.init)(params)
+
+    def shard_batch(self, tokens) -> jnp.ndarray:
+        return jax.device_put(tokens, self._batch_sharding)
+
+    # -- drive --
+
+    def step(self, params, opt_state, tokens) -> Tuple[jnp.ndarray, Any, Any]:
+        """Fused grads+update (single replica group / no FT averaging)."""
+        with jax.set_mesh(self.mesh):
+            return self._fused(params, opt_state, tokens)
+
+    def grads(self, params, tokens) -> Tuple[jnp.ndarray, Any]:
+        """Loss + gradient pytree (still on device)."""
+        with jax.set_mesh(self.mesh):
+            return self._value_and_grad(params, tokens)
+
+    def apply(self, params, opt_state, grads) -> Tuple[Any, Any]:
+        """Apply (possibly host-averaged) grads."""
+        with jax.set_mesh(self.mesh):
+            return self._apply(params, opt_state, grads)
