@@ -1,0 +1,128 @@
+"""LocalSGD / DiLoCo integration tests (local_sgd_integ_test.py analogue).
+
+Same Runner harness as test_integration.py: real lighthouse + managers,
+replica groups as threads, recovery via HTTP transport. Asserts model (and
+DiLoCo outer-optimizer) state equality across groups after syncs.
+"""
+
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from typing import Any, Dict
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from tests.test_integration import FailureInjector, Runner, _init_params, _loss_fn
+from torchft_tpu.collectives import CollectivesTcp
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.local_sgd import DiLoCo, LocalSGD
+from torchft_tpu.manager import Manager
+
+
+def local_sgd_train_loop(
+    rank: int, store_addr: str, runner: Runner, total_syncs: int = 2
+) -> Dict[str, Any]:
+    import optax
+
+    mode = runner.train_loop_args.get("mode", "local_sgd")
+    sync_every = 3
+
+    holder = {}
+
+    def load_state(sd):
+        holder["params"] = sd["params"]
+        holder["opt_state"] = sd["opt_state"]
+
+    def save_state():
+        return {"params": holder["params"], "opt_state": holder["opt_state"]}
+
+    manager = Manager(
+        collectives=CollectivesTcp(timeout=timedelta(seconds=10)),
+        load_state_dict=load_state,
+        state_dict=save_state,
+        min_replica_size=2,
+        replica_id=str(runner.replica_id),
+        store_addr=store_addr,
+        rank=rank,
+        world_size=runner.world_size,
+        lighthouse_addr=runner.lighthouse_address,
+        timeout=timedelta(seconds=10),
+        use_async_quorum=False,  # DiLoCo requires sync quorum
+    )
+    try:
+        tx = optax.sgd(0.05)
+        holder["params"] = _init_params()
+        holder["opt_state"] = tx.init(holder["params"])
+        grad_fn = jax.jit(jax.grad(_loss_fn))
+        apply_fn = jax.jit(
+            lambda p, o, g: (
+                lambda u: (optax.apply_updates(p, u[0]), u[1])
+            )(tx.update(g, o, p))
+        )
+
+        if mode == "local_sgd":
+            wrapper = LocalSGD(manager, sync_every=sync_every)
+        else:
+            wrapper = DiLoCo(
+                manager,
+                outer_tx=optax.sgd(0.7, momentum=0.9, nesterov=True),
+                sync_every=sync_every,
+            )
+        wrapper.save(holder["params"])
+
+        data_rng = np.random.default_rng(2000 + runner.replica_id * 31 + rank)
+        while manager.current_step() < total_syncs:
+            x = data_rng.standard_normal((8, 3)).astype(np.float32)
+            y = data_rng.standard_normal((8, 4)).astype(np.float32)
+            grads = grad_fn(holder["params"], x, y)
+            holder["params"], holder["opt_state"] = apply_fn(
+                holder["params"], holder["opt_state"], grads
+            )
+            holder["params"] = wrapper.step(holder["params"])
+
+        out = {
+            "params": jax.tree_util.tree_map(np.asarray, holder["params"]),
+            "step": manager.current_step(),
+        }
+        if mode == "diloco":
+            out["outer"] = jax.tree_util.tree_map(
+                np.asarray, wrapper.outer_state()
+            )
+        return out
+    finally:
+        manager.shutdown(wait=False)
+
+
+@pytest.mark.parametrize("mode", ["local_sgd", "diloco"])
+def test_local_sgd_modes(mode):
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+    injectors = [FailureInjector(), FailureInjector()]
+    try:
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            futs = [
+                ex.submit(
+                    Runner(
+                        replica_id=i,
+                        lighthouse_address=lighthouse.address(),
+                        failure_injector=inj,
+                        train_loop=local_sgd_train_loop,
+                        train_loop_args={"mode": mode},
+                    ).run_replica
+                )
+                for i, inj in enumerate(injectors)
+            ]
+            results = [f.result(timeout=120) for f in futs]
+    finally:
+        lighthouse.shutdown()
+
+    a, b = results[0][0], results[1][0]
+    for key in a["params"]:
+        np.testing.assert_array_equal(a["params"][key], b["params"][key])
+    if mode == "diloco":
+        la = jax.tree_util.tree_leaves(a["outer"])
+        lb = jax.tree_util.tree_leaves(b["outer"])
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(x, y)

@@ -1,0 +1,92 @@
+"""FT × mesh composition — the HSDP story, TPU-native.
+
+The reference splices its managed (elastic) process group into the torch
+DeviceMesh so FSDP sees a "replicate" dim of dynamic size
+(ManagedDeviceMesh / ft_init_device_mesh, process_group.py:1361-1606). The
+TPU equivalent keeps the two planes apart by construction:
+
+* inner: a fixed ``jax.sharding.Mesh`` (dp/fsdp/pp/ep/sp/tp) baked into the
+  compiled TrainStep — never changes, never recompiles;
+* outer: the Manager's replica axis on host buffers — gradients cross it
+  via ``manager.allreduce`` between ``grads`` and ``apply``, so quorum
+  membership changes are invisible to XLA.
+
+``FTTrainer`` ties the two together and registers host-side state
+snapshots with the Manager so live recovery (send/recv checkpoint) works
+for sharded params: leaves are gathered to host for transfer and re-placed
+with the TrainStep's shardings on load.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from torchft_tpu.checkpointing.serialization import to_host_tree
+from torchft_tpu.ddp import allreduce_gradients
+from torchft_tpu.manager import Manager
+from torchft_tpu.parallel.train_step import TrainStep
+
+__all__ = ["FTTrainer"]
+
+
+class FTTrainer:
+    def __init__(self, manager: Manager, train_step: TrainStep) -> None:
+        self._manager = manager
+        self._ts = train_step
+        self._params: Optional[Any] = None
+        self._opt_state: Optional[Any] = None
+
+    # -- state (registered with the Manager for live recovery) --
+
+    def init(self, rng) -> None:
+        self._params = self._ts.init_params(rng)
+        self._opt_state = self._ts.init_opt(self._params)
+        self._manager.set_state_dict_fns(self.load_state_dict, self.state_dict)
+
+    @property
+    def params(self) -> Any:
+        return self._params
+
+    @property
+    def opt_state(self) -> Any:
+        return self._opt_state
+
+    def state_dict(self) -> Dict[str, Any]:
+        # host-side snapshot: on multi-host meshes each process contributes
+        # its addressable shards; here the full gather is the transferable
+        # representation for the checkpoint transports
+        return {
+            "params": to_host_tree(self._params),
+            "opt_state": to_host_tree(self._opt_state),
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        import jax
+
+        # re-place the recovered host arrays onto the inner mesh with the
+        # step's shardings (GSPMD re-shards on first use otherwise)
+        self._params = jax.device_put(
+            state["params"], self._ts._param_shardings
+        )
+        # opt_state shardings mirror params; let placement follow use
+        self._opt_state = state["opt_state"]
+
+    # -- drive --
+
+    def step(self, tokens) -> Tuple[float, bool]:
+        """One fault-tolerant step: quorum → device grads → cross-group
+        average (host) → commit gate → device update. Returns
+        (loss, committed)."""
+        self._manager.start_quorum()
+        tokens = self._ts.shard_batch(tokens)
+        loss, grads = self._ts.grads(self._params, tokens)
+        # cross the elastic replica axis on host
+        grads = allreduce_gradients(self._manager, grads)
+        committed = self._manager.should_commit()
+        if committed:
+            self._params, self._opt_state = self._ts.apply(
+                self._params, self._opt_state, grads
+            )
+        return float(loss), committed
