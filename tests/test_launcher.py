@@ -1,0 +1,51 @@
+"""Launcher supervisor tests (torchx.py analogue coverage)."""
+
+import os
+import sys
+
+import pytest
+
+from torchft_tpu.launcher import launch
+
+
+def test_clean_run(tmp_path):
+    code = launch(
+        [sys.executable, "-c", "import sys; sys.exit(0)"],
+        num_groups=2,
+        nproc=1,
+        lighthouse_addr="localhost:1",  # unused by the trivial cmd
+    )
+    assert code == 0
+
+
+def test_restart_on_failure(tmp_path):
+    # first run of group 0 fails (marker absent), restart succeeds
+    marker = tmp_path / "marker"
+    script = (
+        "import os, sys\n"
+        f"m = {str(marker)!r} + os.environ['REPLICA_GROUP_ID']\n"
+        "if os.environ['REPLICA_GROUP_ID'] == '0' and not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.exit(3)\n"
+        "sys.exit(0)\n"
+    )
+    code = launch(
+        [sys.executable, "-c", script],
+        num_groups=2,
+        nproc=1,
+        lighthouse_addr="localhost:1",
+        max_restarts=2,
+    )
+    assert code == 0
+    assert (tmp_path / "marker0").exists()
+
+
+def test_restart_exhaustion():
+    code = launch(
+        [sys.executable, "-c", "import sys; sys.exit(2)"],
+        num_groups=1,
+        nproc=1,
+        lighthouse_addr="localhost:1",
+        max_restarts=1,
+    )
+    assert code == 1

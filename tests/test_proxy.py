@@ -1,0 +1,105 @@
+"""Subprocess-isolated collectives tests (Baby PG tests analogue:
+process_group_test.py:346-397, multiprocessing_test.py)."""
+
+import multiprocessing as mp
+import time
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+
+import numpy as np
+import pytest
+
+from torchft_tpu.collectives import CollectivesTcp, ReduceOp
+from torchft_tpu.multiprocessing import MonitoredQueue
+from torchft_tpu.proxy import CollectivesProxy
+from torchft_tpu.store import StoreServer
+
+
+def make_tcp_backend():
+    return CollectivesTcp(timeout=timedelta(seconds=10))
+
+
+class TestMonitoredQueue:
+    def test_dead_process_detection(self):
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        proc = ctx.Process(target=time.sleep, args=(0.2,))
+        proc.start()
+        proc.join()
+        mq = MonitoredQueue(q)
+        with pytest.raises(RuntimeError, match="dead"):
+            mq.get(proc, timeout=5.0)
+
+    def test_exception_reraise(self):
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        proc = ctx.Process(target=time.sleep, args=(5,))
+        proc.start()
+        try:
+            q.put(ValueError("boom"))
+            mq = MonitoredQueue(q)
+            with pytest.raises(ValueError, match="boom"):
+                mq.get(proc, timeout=5.0)
+        finally:
+            proc.terminate()
+            proc.join()
+
+
+@pytest.fixture
+def proxy_pair():
+    store = StoreServer()
+    proxies = [
+        CollectivesProxy(make_tcp_backend, timeout=timedelta(seconds=20))
+        for _ in range(2)
+    ]
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        list(pool.map(lambda i: proxies[i].configure(store.address(), i, 2), range(2)))
+    yield proxies
+    for p in proxies:
+        p.shutdown()
+    store.shutdown()
+
+
+class TestCollectivesProxy:
+    def test_allreduce_in_place(self, proxy_pair):
+        a = np.array([1.0, 2.0], dtype=np.float32)
+        b = np.array([3.0, 4.0], dtype=np.float32)
+        w0 = proxy_pair[0].allreduce([a], ReduceOp.SUM)
+        w1 = proxy_pair[1].allreduce([b], ReduceOp.SUM)
+        w0.wait(timeout=timedelta(seconds=20))
+        w1.wait(timeout=timedelta(seconds=20))
+        np.testing.assert_allclose(a, [4.0, 6.0])  # caller buffer mutated
+        np.testing.assert_allclose(b, [4.0, 6.0])
+
+    def test_child_kill_surfaces_quickly(self, proxy_pair):
+        proxy_pair[0].kill_child()
+        t0 = time.monotonic()
+        w = proxy_pair[0].allreduce(
+            [np.ones(2, dtype=np.float32)], ReduceOp.SUM
+        )
+        with pytest.raises(Exception):
+            w.wait(timeout=timedelta(seconds=10))
+        assert time.monotonic() - t0 < 5.0
+
+    def test_reconfigure_respawns(self, proxy_pair):
+        store2 = StoreServer()
+        try:
+            proxy_pair[0].kill_child()
+            old_pids = [p._proc.pid for p in proxy_pair]
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                list(
+                    pool.map(
+                        lambda i: proxy_pair[i].configure(store2.address(), i, 2),
+                        range(2),
+                    )
+                )
+            assert [p._proc.pid for p in proxy_pair] != old_pids
+            a = np.ones(4, dtype=np.float32)
+            b = np.ones(4, dtype=np.float32)
+            w0 = proxy_pair[0].allreduce([a], ReduceOp.AVG)
+            w1 = proxy_pair[1].allreduce([b], ReduceOp.AVG)
+            w0.wait(timeout=timedelta(seconds=20))
+            w1.wait(timeout=timedelta(seconds=20))
+            np.testing.assert_allclose(a, 1.0)
+        finally:
+            store2.shutdown()

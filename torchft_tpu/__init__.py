@@ -9,3 +9,53 @@ pjit/shard_map meshes rather than NCCL process groups.
 """
 
 __version__ = "0.1.0"
+
+# Public API (reference: torchft/__init__.py:7-20 exports Manager,
+# Optimizer, DistributedDataParallel, DistributedSampler and the PGs; the
+# TPU-native equivalents below).
+from torchft_tpu.collectives import (  # noqa: E402
+    Collectives,
+    CollectivesDummy,
+    CollectivesTcp,
+    ErrorSwallowingCollectives,
+    ManagedCollectives,
+)
+from torchft_tpu.data import DistributedSampler  # noqa: E402
+from torchft_tpu.manager import Manager, WorldSizeMode  # noqa: E402
+
+__all__ = [
+    "Manager",
+    "WorldSizeMode",
+    "DistributedSampler",
+    "Collectives",
+    "CollectivesTcp",
+    "CollectivesDummy",
+    "ErrorSwallowingCollectives",
+    "ManagedCollectives",
+]
+
+
+def __getattr__(name):
+    # Heavier wrappers import jax/optax; load lazily so the coordination
+    # layer stays importable on lighthouse-only hosts.
+    if name == "ManagedOptimizer":
+        from torchft_tpu.optim import ManagedOptimizer
+
+        return ManagedOptimizer
+    if name in ("LocalSGD", "DiLoCo"):
+        import torchft_tpu.local_sgd as m
+
+        return getattr(m, name)
+    if name == "CollectivesProxy":
+        from torchft_tpu.proxy import CollectivesProxy
+
+        return CollectivesProxy
+    if name == "FTTrainer":
+        from torchft_tpu.parallel.ft import FTTrainer
+
+        return FTTrainer
+    if name == "ParameterServer":
+        from torchft_tpu.parameter_server import ParameterServer
+
+        return ParameterServer
+    raise AttributeError(f"module 'torchft_tpu' has no attribute {name!r}")

@@ -1,0 +1,187 @@
+"""Job launcher — the TorchX/torchrun analogue.
+
+Reference: torchft/torchx.py:11-76 (N replica-group roles, each under
+``torchrun --max_restarts=10``) driven by .torchxconfig. TPU deployments
+have no torchrun; this supervisor fills both roles for single-host runs
+and documents the env contract for cluster schedulers:
+
+    TORCHFT_LIGHTHOUSE   lighthouse host:port
+    TORCHFT_STORE_ADDR   per-replica-group KV store host:port
+    REPLICA_GROUP_ID     group index
+    NUM_REPLICA_GROUPS   total groups
+    RANK / WORLD_SIZE    rank within the group
+
+Each replica group gets its own StoreServer and worker subprocesses; a
+group whose worker dies is torn down and relaunched whole (the reference's
+torchelastic restart, which its integration tests emulate with
+``attempts=3``) up to ``--max-restarts`` times. The lighthouse is spawned
+automatically unless an address is given.
+
+CLI::
+
+    python -m torchft_tpu.launcher --groups 2 --nproc 1 -- \
+        python examples/train_ddp.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["launch", "main"]
+
+
+@dataclass
+class _Group:
+    gid: int
+    store: object
+    procs: List[subprocess.Popen] = field(default_factory=list)
+    restarts: int = 0
+
+
+def _spawn_group(
+    gid: int,
+    cmd: Sequence[str],
+    num_groups: int,
+    nproc: int,
+    lighthouse_addr: str,
+    base_env: Dict[str, str],
+) -> _Group:
+    from torchft_tpu.store import StoreServer
+
+    store = StoreServer()
+    group = _Group(gid=gid, store=store)
+    for rank in range(nproc):
+        env = dict(base_env)
+        env.update(
+            TORCHFT_LIGHTHOUSE=lighthouse_addr,
+            TORCHFT_STORE_ADDR=store.address(),
+            REPLICA_GROUP_ID=str(gid),
+            NUM_REPLICA_GROUPS=str(num_groups),
+            RANK=str(rank),
+            WORLD_SIZE=str(nproc),
+        )
+        group.procs.append(subprocess.Popen(list(cmd), env=env))
+    return group
+
+
+def _teardown_group(group: _Group) -> None:
+    for p in group.procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.monotonic() + 5
+    for p in group.procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+    group.store.shutdown()
+
+
+def launch(
+    cmd: Sequence[str],
+    num_groups: int = 2,
+    nproc: int = 1,
+    lighthouse_addr: Optional[str] = None,
+    max_restarts: int = 10,
+    min_replicas: Optional[int] = None,
+) -> int:
+    """Run ``cmd`` as ``num_groups`` fault-tolerant replica groups of
+    ``nproc`` workers. Returns the exit code (0 iff every group finished
+    clean)."""
+    lighthouse = None
+    if lighthouse_addr is None:
+        from torchft_tpu.coordination import LighthouseServer
+
+        lighthouse = LighthouseServer(
+            bind="[::]:0", min_replicas=min_replicas or num_groups
+        )
+        # address() is http://host:port — the env var carries host:port
+        lighthouse_addr = lighthouse.address().split("//", 1)[-1]
+        logger.info("spawned lighthouse at %s", lighthouse_addr)
+
+    base_env = dict(os.environ)
+    groups = [
+        _spawn_group(g, cmd, num_groups, nproc, lighthouse_addr, base_env)
+        for g in range(num_groups)
+    ]
+    exit_code = 0
+    try:
+        while groups:
+            time.sleep(0.5)
+            for group in list(groups):
+                codes = [p.poll() for p in group.procs]
+                if all(c == 0 for c in codes):
+                    logger.info("group %d finished clean", group.gid)
+                    _teardown_group(group)
+                    groups.remove(group)
+                elif any(c is not None and c != 0 for c in codes):
+                    logger.warning(
+                        "group %d worker died (codes %s)", group.gid, codes
+                    )
+                    _teardown_group(group)
+                    groups.remove(group)
+                    if group.restarts < max_restarts:
+                        fresh = _spawn_group(
+                            group.gid, cmd, num_groups, nproc,
+                            lighthouse_addr, base_env,
+                        )
+                        fresh.restarts = group.restarts + 1
+                        groups.append(fresh)
+                        logger.info(
+                            "restarted group %d (restart %d/%d)",
+                            group.gid, fresh.restarts, max_restarts,
+                        )
+                    else:
+                        logger.error(
+                            "group %d exhausted restarts", group.gid
+                        )
+                        exit_code = 1
+    except KeyboardInterrupt:
+        exit_code = 130
+    finally:
+        for group in groups:
+            _teardown_group(group)
+        if lighthouse is not None:
+            lighthouse.shutdown()
+    return exit_code
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    parser = argparse.ArgumentParser(
+        description="Launch N fault-tolerant replica groups of a training script"
+    )
+    parser.add_argument("--groups", type=int, default=2)
+    parser.add_argument("--nproc", type=int, default=1, help="workers per group")
+    parser.add_argument("--lighthouse", default=None, help="existing host:port")
+    parser.add_argument("--max-restarts", type=int, default=10)
+    parser.add_argument("--min-replicas", type=int, default=None)
+    parser.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        parser.error("no command given (use: launcher [opts] -- cmd ...)")
+    logging.basicConfig(level=logging.INFO)
+    sys.exit(
+        launch(
+            cmd,
+            num_groups=args.groups,
+            nproc=args.nproc,
+            lighthouse_addr=args.lighthouse,
+            max_restarts=args.max_restarts,
+            min_replicas=args.min_replicas,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,235 @@
+"""Subprocess-isolated collectives — the "Baby" process-group analogue.
+
+Reference: ProcessGroupBabyGloo/BabyNCCL (process_group.py:795-1329): the
+real transport runs in a *spawned child process* so a wedged or crashed
+backend can be SIGKILLed and respawned without taking down the trainer.
+On TPU the same hazard exists for the host-side DCN data plane (a peer
+dies mid-collective and the socket never errors); `CollectivesProxy` wraps
+any `Collectives` backend the same way:
+
+* ``configure`` kills the previous child and spawns a fresh one that
+  builds the backend and rendezvouses;
+* every op ships its arrays to the child over monitored queues, executes
+  synchronously there, and the result is copied back into the caller's
+  buffers (in-place semantics preserved);
+* child death surfaces as RuntimeError on the next op within ~1s — the
+  Manager latches it and reconfigures at the next quorum.
+
+Payloads travel by pickle; for the cross-replica-group control volumes this
+framework routes through the proxy (gradient buckets), the copy is cheap
+relative to the network hop, and unlike the reference's shared-memory
+tensors it keeps the child fully crash-isolated.
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing as mp
+import threading
+from datetime import timedelta
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from torchft_tpu.collectives import Collectives, ReduceOp, Work
+from torchft_tpu.futures import Future
+from torchft_tpu.multiprocessing import MonitoredQueue
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CollectivesProxy"]
+
+
+def _worker(factory, store_addr, rank, world_size, tx, rx) -> None:
+    """Child main: build the backend, rendezvous, serve ops sequentially."""
+    try:
+        backend: Collectives = factory()
+        backend.configure(store_addr, rank, world_size)
+        rx.put(("ready", None, None))
+    except Exception as e:  # noqa: BLE001
+        rx.put(("err", None, e))
+        return
+    while True:
+        cmd = tx.get()
+        if cmd is None:
+            backend.shutdown()
+            return
+        op_id, name, args, kwargs = cmd
+        try:
+            work = getattr(backend, name)(*args, **kwargs)
+            result = work.wait()
+            rx.put(("ok", op_id, result))
+        except Exception as e:  # noqa: BLE001
+            rx.put(("err", op_id, e))
+
+
+class CollectivesProxy(Collectives):
+    """Run a Collectives backend in a kill-safe child process."""
+
+    def __init__(
+        self,
+        factory: Callable[[], Collectives],
+        timeout: timedelta = timedelta(seconds=60),
+    ) -> None:
+        """``factory`` must be picklable (module-level callable) — it runs
+        in the spawned child to build the real backend."""
+        self._factory = factory
+        self._timeout = timeout
+        self._ctx = mp.get_context("spawn")
+        self._proc: Optional[mp.Process] = None
+        self._tx: Optional[mp.Queue] = None
+        self._rx: Optional[MonitoredQueue] = None
+        self._rank = -1
+        self._world = 0
+        self._op_id = 0
+        self._generation = 0
+        self._pending: Dict[int, Future] = {}
+        self._lock = threading.Lock()
+        self._drain: Optional[threading.Thread] = None
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        self.shutdown()
+        self._rank, self._world = rank, world_size
+        with self._lock:
+            self._generation += 1
+            gen = self._generation
+        tx = self._ctx.Queue()
+        rx = MonitoredQueue(self._ctx.Queue())
+        proc = self._ctx.Process(
+            target=_worker,
+            args=(self._factory, store_addr, rank, world_size, tx, rx._q),
+            daemon=True,
+        )
+        proc.start()
+        try:
+            status, _, err = rx.get(proc, timeout=self._timeout)
+            if status == "err":
+                raise err
+        except BaseException:
+            # never leave a live undrained child behind a failed handshake
+            proc.kill()
+            proc.join(timeout=2)
+            raise
+        self._proc, self._tx, self._rx = proc, tx, rx
+        # drain thread closes over its own generation's proc/rx so a stale
+        # thread from a previous child can never touch the new pending map
+        self._drain = threading.Thread(
+            target=self._drain_loop, args=(proc, rx, gen), daemon=True
+        )
+        self._drain.start()
+
+    def _drain_loop(self, proc, rx: MonitoredQueue, gen: int) -> None:
+        while True:
+            try:
+                status, op_id, payload = rx.get(proc, timeout=None)
+            except Exception as e:  # noqa: BLE001 — child died: fail all pending
+                with self._lock:
+                    if gen != self._generation:
+                        return  # a newer generation owns the pending map
+                    pending, self._pending = self._pending, {}
+                for fut in pending.values():
+                    fut.set_exception(
+                        RuntimeError(f"collectives child died: {e}")
+                    )
+                return
+            with self._lock:
+                if gen != self._generation:
+                    return
+                fut = self._pending.pop(op_id, None)
+            if fut is None:
+                continue
+            if status == "ok":
+                fut.set_result(payload)
+            else:
+                fut.set_exception(payload)
+
+    def _submit(self, name: str, *args, **kwargs) -> Work:
+        proc = self._proc
+        if proc is None or not proc.is_alive():
+            return Work(
+                Future.failed(RuntimeError("collectives child is not running"))
+            )
+        fut: Future = Future()
+        with self._lock:
+            self._op_id += 1
+            op_id = self._op_id
+            self._pending[op_id] = fut
+        try:
+            MonitoredQueue(self._tx).put(
+                (op_id, name, args, kwargs), proc, timeout=self._timeout
+            )
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                self._pending.pop(op_id, None)
+            return Work(Future.failed(e))
+        return Work(fut)
+
+    def _copy_back(self, work: Work, arrays: List[np.ndarray]) -> Work:
+        """In-place semantics: copy the child's result into caller buffers."""
+
+        def copy(fut: Future):
+            result = fut.value()
+            out = result if isinstance(result, list) else [result]
+            for dst, src in zip(arrays, out):
+                if isinstance(src, np.ndarray) and dst.shape == src.shape:
+                    np.copyto(dst, src)
+            return result
+
+        return Work(work.get_future().then(copy))
+
+    # -- collectives --
+
+    def allreduce(self, arrays, op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._copy_back(self._submit("allreduce", arrays, op), arrays)
+
+    def allgather(self, arr) -> Work:
+        return self._submit("allgather", arr)
+
+    def broadcast(self, arr, root: int = 0) -> Work:
+        return self._copy_back(self._submit("broadcast", arr, root), [arr])
+
+    def reduce_scatter(self, arrays, op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._submit("reduce_scatter", arrays, op)
+
+    def alltoall(self, arrays) -> Work:
+        return self._submit("alltoall", arrays)
+
+    def send(self, arr, dst: int, tag: int = 0) -> Work:
+        return self._submit("send", arr, dst, tag)
+
+    def recv(self, arr, src: int, tag: int = 0) -> Work:
+        return self._copy_back(self._submit("recv", arr, src, tag), [arr])
+
+    def barrier(self) -> Work:
+        return self._submit("barrier")
+
+    def size(self) -> int:
+        return self._world
+
+    def rank(self) -> int:
+        return self._rank
+
+    def num_active_work(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def kill_child(self) -> None:
+        """Test hook / emergency hatch: SIGKILL the child (simulates a
+        wedged backend)."""
+        if self._proc is not None and self._proc.is_alive():
+            self._proc.kill()
+
+    def shutdown(self) -> None:
+        if self._proc is not None:
+            try:
+                if self._proc.is_alive():
+                    self._tx.put(None)
+                self._proc.join(timeout=2)
+                if self._proc.is_alive():
+                    self._proc.kill()
+                    self._proc.join(timeout=2)
+            except Exception:  # noqa: BLE001
+                pass
+            self._proc = None
+            self._tx = None
+            self._rx = None
