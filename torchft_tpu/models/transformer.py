@@ -53,6 +53,10 @@ class TransformerConfig:
     remat: bool = True
     pp: int = 1  # pipeline stages; n_layers % pp == 0
     microbatches: int = 0  # 0 => = pp
+    # "auto" | "plain" | "flash": auto uses the pallas flash kernel on TPU
+    # for long sequences (where XLA's O(S^2) attention stops fitting);
+    # plain XLA attention wins at short S on this hardware
+    attention_impl: str = "auto"
 
     @property
     def layers_per_stage(self) -> int:
@@ -175,6 +179,46 @@ def _ffn_moe(lp: Dict[str, Any], x: jnp.ndarray, cfg: TransformerConfig) -> jnp.
     return out.reshape(b, s, d)
 
 
+def _use_flash(cfg: TransformerConfig, seq_len: int) -> bool:
+    if cfg.attention_impl == "plain":
+        return False
+    if cfg.attention_impl == "flash":
+        return True
+    if cfg.attention_impl != "auto":
+        raise ValueError(
+            f"attention_impl must be 'auto'|'plain'|'flash', got {cfg.attention_impl!r}"
+        )
+    # auto: the pallas kernel's O(S/blocks) memory is what makes long
+    # sequences compile at all; at short S XLA's fused attention is faster
+    return (
+        jax.default_backend() == "tpu"
+        and seq_len >= 4096
+        and seq_len % 128 == 0
+    )
+
+
+def _flash_sharded(q, k, v, mesh):
+    """Flash attention under GSPMD: pallas_call has no partitioning rules,
+    so without shard_map the SPMD partitioner would all-gather q/k/v onto
+    every chip. Attention is independent per (batch, head), so manualize
+    the batch/head axes and run the kernel per shard."""
+    from torchft_tpu.ops.pallas.flash_attention import flash_attention
+
+    if mesh is None:
+        return flash_attention(q, k, v, causal=True)
+    spec = P(("dp", "fsdp"), None, "tp", None)
+    return jax.shard_map(
+        lambda q, k, v: flash_attention(q, k, v, causal=True),
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={"dp", "fsdp", "tp"},
+        # pallas_call's out_shape carries no varying-manual-axes type, which
+        # the VMA checker would require; the kernel is per-shard local so
+        # the check adds nothing here
+        check_vma=False,
+    )(q, k, v)
+
+
 def _make_layer_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
     sp_size = mesh.shape.get("sp", 1) if mesh is not None else 1
 
@@ -195,6 +239,8 @@ def _make_layer_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
             att = ring_attention_local(q, k, v, sp_size, causal=True)
         elif sp_size > 1:
             att = ring_attention(q, k, v, mesh, causal=True)
+        elif _use_flash(cfg, s):
+            att = _flash_sharded(q, k, v, mesh)
         else:
             att = attention(q, k, v, causal=True)
         x = x + att.reshape(b, s, cfg.qkv_dim) @ lp["wo"]

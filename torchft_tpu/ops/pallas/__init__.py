@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops.
+
+XLA fuses elementwise chains into matmuls on its own; these kernels cover
+what it can't — fusion *across* the attention softmax (flash attention's
+O(S) memory recurrence). CPU tests run the same kernels in interpreter
+mode.
+"""
+
+from torchft_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]
