@@ -73,6 +73,19 @@ class TestSerialization:
         raw = [np.frombuffer(memoryview(b).cast("B"), dtype=np.uint8) for b in bufs]
         assert_state_equal(STATE, unflatten_state(header, raw))
 
+    def test_to_host_tree_copy_never_aliases(self):
+        from torchft_tpu.checkpointing.serialization import to_host_tree
+
+        params = {"w": np.arange(6, dtype=np.float32)}
+        backup = to_host_tree(params, copy=True)
+        assert not np.shares_memory(backup["w"], params["w"])
+        params["w"][...] = -1  # in-place inner update
+        np.testing.assert_array_equal(
+            backup["w"], np.arange(6, dtype=np.float32)
+        )
+        # without copy, a contiguous numpy leaf passes through unchanged
+        assert to_host_tree(params)["w"] is params["w"]
+
 
 class TestRWLock:
     def test_readers_shared_writer_exclusive(self):
@@ -112,6 +125,38 @@ class TestRWLock:
         lock.w_release()  # ...then the late reader proceeds
         assert got_read.wait(5)
         r.join(timeout=5)
+
+    def test_writer_timeout_wakes_blocked_readers(self):
+        # a writer that times out must notify readers parked on
+        # `_want_write == 0`, or they stall until their own timeout
+        lock = RWLock(timeout=0.3)
+        lock.r_acquire()  # keeps the writer from ever acquiring
+        got_read = threading.Event()
+
+        def late_reader():
+            lock.r_acquire()
+            got_read.set()
+            lock.r_release()
+
+        writer_done = threading.Event()
+
+        def failing_writer():
+            with pytest.raises(TimeoutError):
+                lock.w_acquire()
+            writer_done.set()
+
+        w = threading.Thread(target=failing_writer)
+        w.start()
+        time.sleep(0.05)  # writer is pending; reader queues behind it
+        r = threading.Thread(target=late_reader)
+        r.start()
+        assert writer_done.wait(2)
+        # reader must wake promptly after the writer's timeout, well before
+        # its own 0.3s deadline from this instant
+        assert got_read.wait(0.2)
+        w.join(timeout=2)
+        r.join(timeout=2)
+        lock.r_release()
 
 
 @pytest.mark.parametrize("num_chunks", [0, 3])

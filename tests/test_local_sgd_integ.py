@@ -96,6 +96,73 @@ def local_sgd_train_loop(
         manager.shutdown(wait=False)
 
 
+class _StubManager:
+    """Single-group manager stand-in: allreduce is identity (average of
+    one), commit outcome is scripted."""
+
+    _use_async_quorum = False
+
+    def __init__(self, commits):
+        self._commits = list(commits)
+
+    def start_quorum(self):
+        pass
+
+    def num_participants(self):
+        return 1
+
+    def errored(self):
+        return None
+
+    def allreduce(self, arr):
+        from torchft_tpu.futures import Future
+
+        np.divide(arr, self.num_participants(), out=arr)
+        return Future.completed(arr)
+
+    def should_commit(self):
+        return self._commits.pop(0)
+
+
+def test_diloco_outer_step_descends_toward_inner_progress():
+    """Locks in the paper-sign pseudogradient (backup − local): with plain
+    SGD at lr=1 the outer step must land exactly on the averaged inner
+    params; a flipped sign would move *away* from the inner progress."""
+    start = {"w": np.zeros(4, dtype=np.float32)}
+    inner = {"w": np.full(4, 2.0, dtype=np.float32)}
+
+    diloco = DiLoCo(_StubManager([True]), optax.sgd(1.0), sync_every=1)
+    diloco.save(start)
+    out = diloco.step(inner)
+    np.testing.assert_allclose(out["w"], inner["w"], atol=1e-6)
+
+    # lr=0.5 moves exactly halfway from the backup toward the inner params
+    diloco = DiLoCo(_StubManager([True]), optax.sgd(0.5), sync_every=1)
+    diloco.save(start)
+    out = diloco.step(inner)
+    np.testing.assert_allclose(out["w"], np.full(4, 1.0), atol=1e-6)
+
+
+def test_local_sgd_backup_does_not_alias_live_params():
+    """Rollback safety: after a committed sync the caller keeps training
+    (possibly in place) on the returned params; a later failed commit must
+    restore the synced snapshot, not the mutated buffer."""
+    lsgd = LocalSGD(_StubManager([True, False, False]), sync_every=1)
+    params = {"w": np.full(4, 3.0, dtype=np.float32)}
+    lsgd.save(params)
+    params["w"][...] = 5.0  # in-place update before the first sync
+    synced = lsgd.step(params)  # commit=True: backup snapshots 5.0
+    np.testing.assert_array_equal(synced["w"], np.full(4, 5.0))
+    synced["w"][...] = 9.0  # in-place inner steps after the sync
+    restored = lsgd.step(synced)  # commit=False: roll back to the snapshot
+    np.testing.assert_array_equal(restored["w"], np.full(4, 5.0))
+    # the restored tree must not alias the snapshot either: mutate it and
+    # fail another sync — the snapshot still restores cleanly
+    restored["w"][...] = 9.0
+    again = lsgd.step(restored)
+    np.testing.assert_array_equal(again["w"], np.full(4, 5.0))
+
+
 @pytest.mark.parametrize("mode", ["local_sgd", "diloco"])
 def test_local_sgd_modes(mode):
     lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)

@@ -120,6 +120,15 @@ def test_user_state_dict(harness):
     assert h.manager._manager_state_dict()["user"] == {"new_state": 1}
 
 
+def test_participation_queries_before_first_quorum(harness):
+    # must not assert-crash pre-quorum (round-1 review weak #3): a trainer
+    # may log participation before its first start_quorum
+    m = harness().manager
+    assert m.num_participants() == 0
+    assert m.participating_rank() is None
+    assert not m.is_participating()
+
+
 def test_quorum_happy(harness):
     h = harness()
     m = h.manager

@@ -46,8 +46,14 @@ class RWLock:
             self._want_write += 1
             try:
                 self._wait(lambda: not self._writer and self._readers == 0)
-            finally:
+            except BaseException:
                 self._want_write -= 1
+                if self._want_write == 0:
+                    # readers block on _want_write == 0; wake them or they
+                    # stall until their own timeout after a writer gives up
+                    self._cond.notify_all()
+                raise
+            self._want_write -= 1
             self._writer = True
 
     def w_release(self) -> None:

@@ -53,16 +53,25 @@ def _is_array(leaf: Any) -> bool:
         return False
 
 
-def _to_host(leaf: Any) -> np.ndarray:
-    arr = np.asarray(leaf)
-    return np.ascontiguousarray(arr)
+def _to_host(leaf: Any, copy: bool = False) -> np.ndarray:
+    arr = np.ascontiguousarray(np.asarray(leaf))
+    if copy and (arr is leaf or not arr.flags.owndata):
+        # ascontiguousarray returns the SAME object for already-contiguous
+        # numpy inputs, and np.asarray of a CPU jax.Array can be a zero-copy
+        # view over the XLA buffer — either way the "snapshot" would alias
+        # live storage. Callers that need a true backup (LocalSGD/DiLoCo
+        # rollback) pass copy=True to force ownership.
+        arr = arr.copy()
+    return arr
 
 
-def to_host_tree(tree: Any) -> Any:
+def to_host_tree(tree: Any, copy: bool = False) -> Any:
     """Pull every array leaf of a pytree to a contiguous host buffer (the
     shared device→host step used by gradient averaging, LocalSGD backups and
-    checkpoint staging)."""
-    return _tree_util().tree_map(_to_host, tree)
+    checkpoint staging). With ``copy=True`` every leaf is guaranteed to own
+    its buffer (no aliasing of the input), which backup/rollback paths
+    require."""
+    return _tree_util().tree_map(lambda l: _to_host(l, copy=copy), tree)
 
 
 def as_bytes(arr: np.ndarray) -> memoryview:

@@ -49,8 +49,11 @@ class LocalSGD:
         self._backup: Optional[Any] = None
 
     def save(self, params: Any) -> None:
-        """Snapshot ``params`` to host as the restore point."""
-        self._backup = _to_host(params)
+        """Snapshot ``params`` to host as the restore point. ``copy=True``
+        guarantees the backup owns its buffers — without it a contiguous
+        numpy params tree would alias the live params and in-place inner
+        updates would silently corrupt the rollback state."""
+        self._backup = _to_host(params, copy=True)
 
     def step(self, params: Any) -> Any:
         """Count one local optimizer step; every ``sync_every`` calls run a
@@ -71,9 +74,13 @@ class LocalSGD:
         # allreduce_gradients averages any pytree — here, the params
         averaged = allreduce_gradients(self._manager, params)
         if self._manager.should_commit():
-            self._backup = averaged
+            # the caller continues training on `averaged`; the backup must
+            # not alias it or in-place inner steps corrupt the restore point
+            self._backup = _to_host(averaged, copy=True)
             return averaged
-        return self._backup  # discard the local steps
+        # discard the local steps; hand out a copy so in-place training on
+        # the restored tree cannot corrupt the snapshot either
+        return _to_host(self._backup, copy=True)
 
 
 class DiLoCo(LocalSGD):
@@ -111,13 +118,13 @@ class DiLoCo(LocalSGD):
         pseudograd = allreduce_gradients(self._manager, pseudograd)
 
         if not self._manager.should_commit():
-            return self._backup
+            return _to_host(self._backup, copy=True)
 
         updates, self._outer_state = self._outer_tx.update(
             pseudograd, self._outer_state, self._backup
         )
         new_params = optax.apply_updates(self._backup, updates)
-        self._backup = _to_host(new_params)
+        self._backup = _to_host(new_params, copy=True)
         return new_params
 
     def outer_state(self) -> Any:

@@ -433,6 +433,12 @@ class Manager:
     def should_commit(self, timeout: Optional[timedelta] = None) -> bool:
         """Per-step commit barrier: True iff every rank in the group had a
         clean step. Call after backward, step the optimizer only on True."""
+        # keep the commit path loud on misuse: the pre-quorum guards on the
+        # read-only participation queries must not turn a missing
+        # start_quorum into a silent quorum-wide veto
+        assert (
+            self._quorum_future is not None
+        ), "must call start_quorum before should_commit"
         for work in self._pending_work:
             if self._errored is not None:
                 break
@@ -495,19 +501,30 @@ class Manager:
         return self._batches_committed
 
     def num_participants(self) -> int:
-        """Replica groups participating in the current step."""
+        """Replica groups participating in the current step; 0 before the
+        first ``start_quorum`` (no assert-crash — reference parity gap noted
+        in round-1 review)."""
+        if self._quorum_future is None:
+            return 0
         self.wait_quorum()
         assert self._participating_world_size >= 0
         return self._participating_world_size
 
     def participating_rank(self) -> Optional[int]:
         """This group's rank among the participating groups, or None for
-        spectators (spares, healing replicas)."""
+        spectators (spares, healing replicas) and before the first
+        ``start_quorum``."""
+        if self._quorum_future is None:
+            return None
         self.wait_quorum()
         return self._participating_rank
 
     def is_participating(self) -> bool:
-        """Whether this replica's contributions count this step."""
+        """Whether this replica's contributions count this step; False
+        before the first ``start_quorum``."""
+        if self._quorum_future is None:
+            return False
+        self.wait_quorum()
         if self._participating_rank is None:
             return False
         if self._healing:

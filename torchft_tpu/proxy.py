@@ -170,9 +170,21 @@ class CollectivesProxy(Collectives):
         def copy(fut: Future):
             result = fut.value()
             out = result if isinstance(result, list) else [result]
+            if len(out) != len(arrays):
+                raise RuntimeError(
+                    f"proxy result count mismatch: sent {len(arrays)} "
+                    f"arrays, child returned {len(out)}"
+                )
             for dst, src in zip(arrays, out):
-                if isinstance(src, np.ndarray) and dst.shape == src.shape:
-                    np.copyto(dst, src)
+                if not isinstance(src, np.ndarray) or dst.shape != src.shape:
+                    # a silent skip here would leave the caller's buffer
+                    # stale while the Work reports success
+                    raise RuntimeError(
+                        f"proxy result mismatch: expected ndarray{dst.shape},"
+                        f" got {type(src).__name__}"
+                        f"{getattr(src, 'shape', '')}"
+                    )
+                np.copyto(dst, src)
             return result
 
         return Work(work.get_future().then(copy))
