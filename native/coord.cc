@@ -37,6 +37,7 @@ Value QuorumMember::to_value() const {
   v.set("step", Value::I(step));
   v.set("world_size", Value::I((int64_t)world_size));
   v.set("shrink_only", Value::B(shrink_only));
+  v.set("commit_failures", Value::I(commit_failures));
   return v;
 }
 
@@ -48,6 +49,7 @@ QuorumMember QuorumMember::from_value(const Value& v) {
   m.step = v.geti("step");
   m.world_size = (uint64_t)v.geti("world_size");
   m.shrink_only = v.getb("shrink_only");
+  m.commit_failures = v.geti("commit_failures", 0);
   return m;
 }
 
@@ -325,11 +327,19 @@ void Lighthouse::quorum_tick() {
   last_reason_ = reason;
   if (!met.has_value()) return;
 
+  // A participant with latched data-plane errors requests a flush: bump the
+  // quorum_id even though membership is unchanged, so every group abandons
+  // the broken epoch and re-rendezvouses (no reference analogue — it can
+  // only reconfigure via membership change, i.e. process restart).
+  bool flush = false;
+  for (const auto& m : *met) flush = flush || m.commit_failures > 0;
+
   if (!state_.prev_quorum.has_value() ||
-      quorum_changed(*met, state_.prev_quorum->participants)) {
+      quorum_changed(*met, state_.prev_quorum->participants) || flush) {
     state_.quorum_id += 1;
-    logline("Detected quorum change, bumping quorum_id to " +
-            std::to_string(state_.quorum_id));
+    logline(std::string(flush ? "Data-plane flush requested"
+                              : "Detected quorum change") +
+            ", bumping quorum_id to " + std::to_string(state_.quorum_id));
   }
   Quorum q;
   q.quorum_id = state_.quorum_id;
@@ -622,6 +632,8 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
   std::unique_lock<std::mutex> lk(mu_);
   checkpoint_metadata_[rank] = req.gets("checkpoint_metadata");
   participants_.insert(rank);
+  pending_commit_failures_ =
+      std::max(pending_commit_failures_, req.geti("commit_failures", 0));
   uint64_t seen = quorum_seq_;
 
   if (participants_.size() >= world_size_) {
@@ -634,6 +646,8 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
     me.step = step;
     me.world_size = world_size_;
     me.shrink_only = req.getb("shrink_only");
+    me.commit_failures = pending_commit_failures_;
+    pending_commit_failures_ = 0;
     Value lreq = Value::M();
     lreq.set("requester", me.to_value());
     // Like the reference (src/manager.rs:181 TODO), the lock is held for the

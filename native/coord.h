@@ -37,6 +37,11 @@ struct QuorumMember {
   int64_t step = 0;
   uint64_t world_size = 0;
   bool shrink_only = false;
+  // Data-plane flush request (extension beyond the reference): a group whose
+  // collectives latched an error asks for a quorum_id bump so EVERY group
+  // reconfigures into a fresh rendezvous epoch — the reference can only
+  // recover a wedged backend via process restart (membership change).
+  int64_t commit_failures = 0;
 
   Value to_value() const;
   static QuorumMember from_value(const Value& v);
@@ -181,6 +186,7 @@ class ManagerSrv {
   std::condition_variable cv_;
   std::map<int64_t, std::string> checkpoint_metadata_;
   std::set<int64_t> participants_;
+  int64_t pending_commit_failures_ = 0;  // max over this round's ranks
   uint64_t quorum_seq_ = 0;
   std::map<uint64_t, Quorum> quorums_;  // seq -> delivered quorum
   std::optional<std::string> quorum_error_;  // lighthouse failure fan-out

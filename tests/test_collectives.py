@@ -229,6 +229,92 @@ class TestMultiRank:
         colls[2].shutdown()
 
 
+class TestWedgedPeers:
+    """Round-1 review weak #2: a dead/silent peer must not wedge the op
+    thread forever, and teardown must not leak blocked threads
+    (reference: process_group_test.py:346-397 reconfigure/leak checks)."""
+
+    def _pair(self, store, timeout_s):
+        colls = [
+            CollectivesTcp(
+                timeout=timedelta(seconds=timeout_s), hostname="localhost"
+            )
+            for _ in range(2)
+        ]
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            list(
+                ex.map(
+                    lambda r: colls[r].configure(
+                        f"{store.address()}/wedge", r, 2
+                    ),
+                    range(2),
+                )
+            )
+        return colls
+
+    def test_silent_peer_times_out(self, store):
+        import time
+
+        c0, c1 = self._pair(store, timeout_s=1)
+        try:
+            # rank 1 never participates: rank 0's ring recv must fail with a
+            # timeout within the configured deadline, not block forever
+            a = np.ones(8, dtype=np.float32)
+            t0 = time.monotonic()
+            with pytest.raises(Exception):
+                c0.allreduce([a], ReduceOp.SUM).wait(timedelta(seconds=5))
+            assert time.monotonic() - t0 < 4.0
+        finally:
+            c0.shutdown()
+            c1.shutdown()
+
+    def test_shutdown_unblocks_wedged_op_and_leaks_no_threads(self, store):
+        import threading
+        import time
+
+        def coll_threads():
+            return [
+                t
+                for t in threading.enumerate()
+                if t.name.startswith("tft_coll")
+            ]
+
+        baseline = len(coll_threads())
+        c0, c1 = self._pair(store, timeout_s=30)
+        a = np.ones(8, dtype=np.float32)
+        work = c0.allreduce([a], ReduceOp.SUM)  # blocks: peer is silent
+        queued = c0.allreduce([a.copy()], ReduceOp.SUM)  # parked behind it
+        time.sleep(0.2)
+        t0 = time.monotonic()
+        c0.shutdown()  # must wake the blocked op and join the executor
+        assert time.monotonic() - t0 < 5.0
+        with pytest.raises(Exception):
+            work.wait(timedelta(seconds=1))
+        # the cancelled queued op must resolve too, not hang its waiter
+        with pytest.raises(Exception):
+            queued.wait(timedelta(seconds=1))
+        c1.shutdown()
+        deadline = time.monotonic() + 5
+        while len(coll_threads()) > baseline and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(coll_threads()) <= baseline
+
+    def test_repeated_reconfigure_leaks_no_threads(self, store):
+        import threading
+        import time
+
+        before = threading.active_count()
+        c = CollectivesTcp(timeout=timedelta(seconds=5), hostname="localhost")
+        for epoch in range(5):
+            c.configure(f"{store.address()}/re{epoch}", 0, 1)
+            c.allreduce([np.ones(4, dtype=np.float32)]).wait()
+        c.shutdown()
+        deadline = time.monotonic() + 5
+        while threading.active_count() > before and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before + 1  # store client slack
+
+
 class TestWrappers:
     def test_dummy(self):
         c = CollectivesDummy(rank=0, world_size=2)

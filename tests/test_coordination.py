@@ -314,6 +314,24 @@ class TestLighthouseE2E:
         finally:
             lh.shutdown()
 
+    def test_commit_failures_flush_bumps_quorum_id(self):
+        # data-plane flush: a member with latched commit failures forces a
+        # quorum_id bump even though membership is unchanged, so every group
+        # re-rendezvouses its collectives into a fresh epoch
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1, join_timeout_ms=100)
+        try:
+            c = LighthouseClient(lh.address(), connect_timeout=timedelta(seconds=5))
+            q1 = c.quorum(member("a", step=1), timeout=timedelta(seconds=5))
+            flushing = dict(member("a", step=1), commit_failures=1)
+            q2 = c.quorum(flushing, timeout=timedelta(seconds=5))
+            assert q2["quorum_id"] == q1["quorum_id"] + 1
+            # flush consumed: a clean re-request keeps the new id
+            q3 = c.quorum(member("a", step=2), timeout=timedelta(seconds=5))
+            assert q3["quorum_id"] == q2["quorum_id"]
+            c.close()
+        finally:
+            lh.shutdown()
+
 
 class TestManagerE2E:
     def _setup(self, n_replicas=2, world_size=1, min_replicas=2):

@@ -81,6 +81,89 @@ class TestCollectivesProxy:
             w.wait(timeout=timedelta(seconds=10))
         assert time.monotonic() - t0 < 5.0
 
+    def test_manager_over_proxy_kill_child_recovers_without_restart(self):
+        """The Baby-PG story end to end (round-1 review 'what's weak' #2):
+        Manager drives subprocess-isolated collectives; a SIGKILLed child
+        mid-run latches an error, the failed commit requests a data-plane
+        flush, the next quorum bumps quorum_id for BOTH groups, configure()
+        respawns the child, and training recovers to identical states —
+        no trainer process/thread restart involved."""
+        from torchft_tpu.coordination import LighthouseServer
+        from torchft_tpu.manager import Manager
+        from torchft_tpu.optim import ManagedOptimizer
+
+        from tests.test_integration import _init_params, _loss_fn
+
+        import jax
+        import optax
+
+        lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+        stores = [StoreServer() for _ in range(2)]
+        kill_once = {"done": False}
+        total_steps = 4
+
+        def loop(gid):
+            manager = Manager(
+                collectives=CollectivesProxy(
+                    make_tcp_backend, timeout=timedelta(seconds=20)
+                ),
+                load_state_dict=None,
+                state_dict=None,
+                min_replica_size=2,
+                replica_id=str(gid),
+                store_addr=stores[gid].address(),
+                rank=0,
+                world_size=1,
+                lighthouse_addr=lighthouse.address(),
+                timeout=timedelta(seconds=15),
+                quorum_timeout=timedelta(seconds=30),
+            )
+            try:
+                opt = ManagedOptimizer(manager, optax.sgd(0.05))
+                opt.init(_init_params())
+                grad_fn = jax.jit(jax.grad(_loss_fn))
+                rng = np.random.default_rng(77 + gid)
+                commits = []
+                for _ in range(40):
+                    opt.begin_step()
+                    x = rng.standard_normal((8, 3)).astype(np.float32)
+                    y = rng.standard_normal((8, 4)).astype(np.float32)
+                    if (
+                        gid == 1
+                        and manager.current_step() == 2
+                        and not kill_once["done"]
+                    ):
+                        kill_once["done"] = True
+                        manager._collectives.kill_child()
+                    grads = grad_fn(opt.params, x, y)
+                    before = manager.current_step()
+                    opt.step(grads)
+                    commits.append(manager.current_step() > before)
+                    if manager.current_step() >= total_steps:
+                        break
+                return {
+                    "params": jax.tree_util.tree_map(np.asarray, opt.params),
+                    "commits": commits,
+                    "step": manager.current_step(),
+                }
+            finally:
+                manager.shutdown(wait=False)
+
+        try:
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                a, b = list(ex.map(loop, range(2)))
+        finally:
+            for s in stores:
+                s.shutdown()
+            lighthouse.shutdown()
+
+        assert a["step"] >= total_steps and b["step"] >= total_steps
+        # the killed-child step must NOT have committed on either group...
+        assert False in a["commits"] and False in b["commits"]
+        # ...and both groups converge to bit-identical params afterwards
+        for key in a["params"]:
+            np.testing.assert_array_equal(a["params"][key], b["params"][key])
+
     def test_reconfigure_respawns(self, proxy_pair):
         store2 = StoreServer()
         try:

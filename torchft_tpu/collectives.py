@@ -224,15 +224,15 @@ class CollectivesTcp(Collectives):
     # -- lifecycle --
 
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
-        self._teardown()
+        self._teardown()  # bumps _generation, so stale acceptors are fenced
         self._rank = rank
         self._world = world_size
         # Tags order ops SPMD-style, so every member must restart the
         # sequence together; configure() is that barrier (a rejoining
         # replica starts at 0 while survivors would otherwise keep counting).
         self._op_seq = 0
-        self._generation += 1
-        gen = self._generation
+        with self._peers_lock:
+            gen = self._generation
         if world_size == 1:
             self._executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="tft_coll"
@@ -286,6 +286,10 @@ class CollectivesTcp(Collectives):
             except OSError:
                 return  # listener closed by teardown
             try:
+                # deadline BEFORE the hello too: a connected-but-silent
+                # dialer (killed mid-handshake, port scanner) must not wedge
+                # the acceptor thread past the op timeout
+                sock.settimeout(self._timeout.total_seconds())
                 hello = _recv_exact(sock, 8)
                 magic, peer_rank = struct.unpack("<II", bytes(hello))
                 if magic != _HELLO_MAGIC:
@@ -307,16 +311,25 @@ class CollectivesTcp(Collectives):
         sock = socket.create_connection(
             (host, int(port)), timeout=timeout.total_seconds()
         )
-        sock.settimeout(None)
+        # keep the op-timeout deadline on the connected socket (a dead peer
+        # mid-ring must not wedge the op thread past self._timeout)
+        sock.settimeout(self._timeout.total_seconds())
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.sendall(struct.pack("<II", _HELLO_MAGIC, self._rank))
         with self._peers_lock:
             self._peers[peer] = _Peer(sock)
 
     def _teardown(self) -> None:
-        if self._executor is not None:
-            self._executor.shutdown(wait=False, cancel_futures=True)
-            self._executor = None
+        # Order matters (round-1 review weak #2): fence stale acceptor
+        # threads, then unblock any op thread stuck in a socket syscall
+        # (shutdown() wakes a blocked recv/send; close() alone does not on
+        # Linux), THEN join the executor so reconfigure never leaks a
+        # wedged worker thread.
+        with self._peers_lock:
+            # the epoch ends HERE, not at the next configure(): an old
+            # acceptor completing a handshake after this point must never
+            # insert its socket into the next epoch's peer map
+            self._generation += 1
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -326,10 +339,17 @@ class CollectivesTcp(Collectives):
         with self._peers_lock:
             for p in self._peers.values():
                 try:
+                    p.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
                     p.sock.close()
                 except OSError:
                     pass
             self._peers.clear()
+        if self._executor is not None:
+            self._executor.shutdown(wait=True, cancel_futures=True)
+            self._executor = None
         if self._store is not None:
             self._store.close()
             self._store = None
@@ -362,7 +382,18 @@ class CollectivesTcp(Collectives):
             except BaseException as e:  # noqa: BLE001 — propagate via future
                 out.set_exception(e)
 
-        self._executor.submit(run)
+        task = self._executor.submit(run)
+
+        def on_done(t) -> None:
+            # teardown cancels queued tasks whose run() never executes; the
+            # caller's Work future must still resolve or a timeout-less
+            # wait() would hang forever
+            if t.cancelled() and not out.done():
+                out.set_exception(
+                    RuntimeError("collectives reconfigured before op ran")
+                )
+
+        task.add_done_callback(on_done)
         return Work(out)
 
     def _send_to(self, rank: int, tag: int, data: memoryview) -> None:

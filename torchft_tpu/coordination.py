@@ -159,7 +159,12 @@ class ManagerClient:
         checkpoint_metadata: str,
         shrink_only: bool,
         timeout: timedelta,
+        commit_failures: int = 0,
     ) -> QuorumResult:
+        """``commit_failures > 0`` requests a data-plane flush: the
+        lighthouse bumps quorum_id even without membership change, forcing
+        every group to re-rendezvous its collectives (extension beyond the
+        reference, which needs a process restart for this)."""
         resp = self._client.call(
             "mgr.quorum",
             {
@@ -167,6 +172,7 @@ class ManagerClient:
                 "step": step,
                 "checkpoint_metadata": checkpoint_metadata,
                 "shrink_only": shrink_only,
+                "commit_failures": commit_failures,
             },
             _ms(timeout),
         )

@@ -192,6 +192,7 @@ class Manager:
 
         self._step = 0
         self._quorum_id = -1
+        self._commit_failures = 0  # pending data-plane flush request
         self._errored: Optional[Exception] = None
         self._healing = False
         self._pending_work: List[Future] = []
@@ -270,6 +271,9 @@ class Manager:
             checkpoint_metadata=self._checkpoint_transport.metadata(),
             shrink_only=shrink_only,
             timeout=quorum_timeout,
+            # latched data-plane errors request a flush: quorum_id bumps so
+            # all groups (including healthy ones) re-rendezvous together
+            commit_failures=self._commit_failures,
         )
 
         # Async quorum overlaps the forward pass, so a healing replica can't
@@ -304,6 +308,8 @@ class Manager:
                 store_prefixed_addr, quorum.replica_rank, quorum.replica_world_size
             )
             self._quorum_id = quorum.quorum_id
+            # fresh epoch: the flush request (if any) has been honored
+            self._commit_failures = 0
 
         if allow_heal:
             if quorum.recover_dst_ranks:
@@ -468,6 +474,11 @@ class Manager:
         # close the checkpoint-serving window: after the commit the staged
         # state is stale
         self._checkpoint_transport.disallow_checkpoint()
+
+        if self._errored is not None:
+            # the data plane is suspect: request a flush so the next quorum
+            # reconfigures every group into a fresh rendezvous epoch
+            self._commit_failures += 1
 
         if should_commit:
             self._step += 1
