@@ -1,0 +1,256 @@
+"""Device-path collectives conformance tests.
+
+Same strategy as test_collectives.py (reference process_group_test.py:67-251)
+but over jax.Arrays on the virtual 8-device CPU mesh: replica groups as
+threads, each owning a disjoint device set, averaging via the stacked
+'ft'-axis shard_map psum. Verifies results keep each group's original
+devices/sharding, SPMD desync detection, reconfiguration, and dead-peer
+timeouts.
+"""
+
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torchft_tpu.collectives import ReduceOp
+from torchft_tpu.collectives_device import CollectivesDevice
+from torchft_tpu.parallel.mesh import MeshConfig, make_mesh
+
+EPOCH = ["e0"]
+
+
+def _fresh_prefix() -> str:
+    # unique epoch per test (the registry is keyed by the store prefix)
+    EPOCH[0] = EPOCH[0] + "x"
+    return f"store:0/torchft/{EPOCH[0]}"
+
+
+def _run_world(world, fn, timeout_s=10):
+    prefix = _fresh_prefix()
+    colls = [CollectivesDevice(timeout=timedelta(seconds=timeout_s)) for _ in range(world)]
+
+    def start(rank):
+        colls[rank].configure(f"{prefix}/{rank}", rank, world)
+        try:
+            return fn(colls[rank], rank)
+        finally:
+            colls[rank].shutdown()
+
+    with ThreadPoolExecutor(max_workers=world) as ex:
+        return list(ex.map(start, range(world)))
+
+
+class TestSingleGroup:
+    def test_allreduce_identity_no_host(self):
+        c = CollectivesDevice(timeout=timedelta(seconds=5))
+        c.configure(f"{_fresh_prefix()}/0", 0, 1)
+        a = jnp.arange(8, dtype=jnp.float32)
+        out = c.allreduce([a], ReduceOp.SUM).wait()
+        assert out[0] is a  # world-1 fast path: no copy, no kernel
+        c.shutdown()
+
+
+class TestMultiGroup:
+    @pytest.mark.parametrize("world", [2, 4])
+    def test_allreduce_sum_single_device_groups(self, world):
+        devs = jax.devices()
+
+        def fn(c, rank):
+            a = jax.device_put(
+                jnp.full((6, 3), float(rank + 1), jnp.float32), devs[rank]
+            )
+            out = c.allreduce([a], ReduceOp.SUM).wait()
+            return out[0]
+
+        results = _run_world(world, fn)
+        want = sum(range(1, world + 1))
+        for rank, r in enumerate(results):
+            np.testing.assert_array_equal(np.asarray(r), want)
+            assert list(r.devices()) == [devs[rank]]  # stayed on its device
+
+    def test_allreduce_sharded_groups_keep_sharding(self):
+        """Two groups × 4-device inner mesh (dp=2, tp=2): the HSDP layout."""
+        devs = jax.devices()
+        meshes = [
+            make_mesh(MeshConfig(dp=2, tp=2), devices=devs[r * 4 : (r + 1) * 4])
+            for r in range(2)
+        ]
+        spec = P(("dp", "fsdp"), "tp")
+
+        def fn(c, rank):
+            sharding = NamedSharding(meshes[rank], spec)
+            a = jax.device_put(
+                jnp.arange(32, dtype=jnp.float32).reshape(8, 4) * (rank + 1),
+                sharding,
+            )
+            out = c.allreduce([a, a * 2], ReduceOp.SUM).wait()
+            return out
+
+        results = _run_world(2, fn)
+        base = np.arange(32, dtype=np.float32).reshape(8, 4)
+        for rank, (x, y) in enumerate(results):
+            np.testing.assert_array_equal(np.asarray(x), base * 3)
+            np.testing.assert_array_equal(np.asarray(y), base * 6)
+            assert x.sharding.mesh.devices.tolist() == meshes[rank].devices.tolist()
+            assert x.sharding.spec == spec
+
+    def test_allreduce_avg_max_min(self):
+        devs = jax.devices()
+
+        def fn(c, rank):
+            a = jax.device_put(jnp.full((4,), float(rank), jnp.float32), devs[rank])
+            avg = c.allreduce([a], ReduceOp.AVG).wait()[0]
+            mx = c.allreduce([a], ReduceOp.MAX).wait()[0]
+            mn = c.allreduce([a], ReduceOp.MIN).wait()[0]
+            return np.asarray(avg), np.asarray(mx), np.asarray(mn)
+
+        for avg, mx, mn in _run_world(3, fn):
+            np.testing.assert_allclose(avg, 1.0)
+            np.testing.assert_array_equal(mx, 2.0)
+            np.testing.assert_array_equal(mn, 0.0)
+
+    def test_allgather_broadcast_alltoall_reduce_scatter_barrier(self):
+        devs = jax.devices()
+        world = 3
+
+        def fn(c, rank):
+            a = jax.device_put(jnp.full((2,), float(rank), jnp.float32), devs[rank])
+            ag = c.allgather(a).wait()
+            got_ag = [float(np.asarray(x)[0]) for x in ag]
+
+            b = jax.device_put(jnp.full((2,), float(rank), jnp.float32), devs[rank])
+            bc = c.broadcast(b, root=1).wait()
+
+            ins = [
+                jax.device_put(
+                    jnp.full((2,), float(rank * 10 + j), jnp.float32), devs[rank]
+                )
+                for j in range(world)
+            ]
+            a2a = c.alltoall(ins).wait()
+            got_a2a = [float(np.asarray(x)[0]) for x in a2a]
+
+            rs = c.reduce_scatter(ins, ReduceOp.SUM).wait()
+
+            c.barrier().wait()
+            return got_ag, float(np.asarray(bc)[0]), got_a2a, float(np.asarray(rs)[0])
+
+        results = _run_world(world, fn)
+        for rank, (ag, bc, a2a, rs) in enumerate(results):
+            assert ag == [0.0, 1.0, 2.0]
+            assert bc == 1.0
+            assert a2a == [j * 10 + rank for j in range(world)]
+            # sum over senders j of (j*10 + rank)
+            assert rs == sum(j * 10 + rank for j in range(world))
+
+    def test_send_recv(self):
+        devs = jax.devices()
+
+        def fn(c, rank):
+            if rank == 0:
+                a = jax.device_put(jnp.arange(4, dtype=jnp.float32), devs[0])
+                c.send(a, dst=1, tag=7).wait()
+                return None
+            buf = jax.device_put(jnp.zeros(4, jnp.float32), devs[1])
+            got = c.recv(buf, src=0, tag=7).wait()
+            return np.asarray(got)
+
+        results = _run_world(2, fn)
+        np.testing.assert_array_equal(results[1], np.arange(4, dtype=np.float32))
+
+    def test_desync_detection(self):
+        """Mismatched op kinds at the same SPMD slot fail BOTH groups fast
+        (the TCP backend's frame-tag desync analogue)."""
+
+        def fn(c, rank):
+            a = jnp.ones(2)
+            with pytest.raises(RuntimeError):
+                c.barrier().wait(timedelta(seconds=5))
+                # rank 0 issues allreduce where rank 1 issues allgather: the
+                # second arriver raises synchronously, the first via its future
+                if rank == 0:
+                    c.allreduce([a]).wait(timedelta(seconds=5))
+                else:
+                    c.allgather(a).wait(timedelta(seconds=5))
+            return True
+
+        assert all(_run_world(2, fn))
+
+
+class TestLifecycle:
+    def test_reconfigure_new_epoch(self):
+        devs = jax.devices()
+        world = 2
+        prefix1, prefix2 = _fresh_prefix(), _fresh_prefix()
+        colls = [CollectivesDevice(timeout=timedelta(seconds=10)) for _ in range(world)]
+
+        def run(rank):
+            c = colls[rank]
+            a = jax.device_put(jnp.full((2,), 1.0, jnp.float32), devs[rank])
+            c.configure(f"{prefix1}/{rank}", rank, world)
+            r1 = np.asarray(c.allreduce([a]).wait()[0])
+            c.configure(f"{prefix2}/{rank}", rank, world)
+            r2 = np.asarray(c.allreduce([a]).wait()[0])
+            c.shutdown()
+            return r1, r2
+
+        with ThreadPoolExecutor(max_workers=world) as ex:
+            for r1, r2 in ex.map(run, range(world)):
+                np.testing.assert_array_equal(r1, 2.0)
+                np.testing.assert_array_equal(r2, 2.0)
+
+    def test_dead_peer_times_out(self):
+        """A group that never shows up fails the op within the deadline,
+        not forever (the TCP backend's silent-peer analogue)."""
+        prefix = _fresh_prefix()
+        c0 = CollectivesDevice(timeout=timedelta(seconds=1))
+        c1 = CollectivesDevice(timeout=timedelta(seconds=30))
+
+        def join(c, rank):
+            c.configure(f"{prefix}/{rank}", rank, 2)
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            list(ex.map(lambda args: join(*args), [(c0, 0), (c1, 1)]))
+
+        # rank 1 never calls allreduce
+        work = c0.allreduce([jnp.ones(2)])
+        with pytest.raises(TimeoutError):
+            work.wait(timedelta(seconds=5))
+        c0.shutdown()
+        c1.shutdown()
+
+    def test_reconfigure_fails_pending_ops(self):
+        """A member leaving (reconfigure) resolves the other members'
+        in-flight futures with an error instead of stranding them."""
+        prefix = _fresh_prefix()
+        c0 = CollectivesDevice(timeout=timedelta(seconds=30))
+        c1 = CollectivesDevice(timeout=timedelta(seconds=30))
+
+        def join(c, rank):
+            c.configure(f"{prefix}/{rank}", rank, 2)
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            list(ex.map(lambda args: join(*args), [(c0, 0), (c1, 1)]))
+
+        work = c0.allreduce([jnp.ones(2)])
+        c1.shutdown()  # leaves the epoch
+        with pytest.raises(RuntimeError, match="reconfigured"):
+            work.wait(timedelta(seconds=5))
+        c0.shutdown()
+
+    def test_incongruent_shardings_error(self):
+        devs = jax.devices()
+
+        def fn(c, rank):
+            shape = (4, 4) if rank == 0 else (2, 8)
+            a = jax.device_put(jnp.ones(shape, jnp.float32), devs[rank])
+            with pytest.raises(RuntimeError, match="congruent"):
+                c.allreduce([a]).wait(timedelta(seconds=5))
+            return True
+
+        assert all(_run_world(2, fn))

@@ -38,14 +38,25 @@ CFG = TransformerConfig(
 
 
 def hsdp_train_loop(
-    rank: int, store_addr: str, runner: Runner, total_steps: int = 3
+    rank: int,
+    store_addr: str,
+    runner: Runner,
+    total_steps: int = 3,
+    backend: str = "tcp",
 ) -> Dict[str, Any]:
     devices = jax.devices()[runner.replica_id * 4 : (runner.replica_id + 1) * 4]
     mesh = make_mesh(MeshConfig(dp=2, tp=2), devices=devices)
     ts = TrainStep(CFG, optax.sgd(0.05), mesh)
 
+    if backend == "device":
+        from torchft_tpu.collectives_device import CollectivesDevice
+
+        collectives = CollectivesDevice(timeout=timedelta(seconds=10))
+    else:
+        collectives = CollectivesTcp(timeout=timedelta(seconds=10))
+
     manager = Manager(
-        collectives=CollectivesTcp(timeout=timedelta(seconds=10)),
+        collectives=collectives,
         load_state_dict=None,  # wired by FTTrainer.init
         state_dict=None,
         min_replica_size=2,
@@ -76,7 +87,9 @@ def hsdp_train_loop(
         manager.shutdown(wait=False)
 
 
-def _run(injectors):
+def _run(injectors, backend: str = "tcp"):
+    import functools
+
     lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
     try:
         with ThreadPoolExecutor(max_workers=2) as ex:
@@ -86,7 +99,9 @@ def _run(injectors):
                         replica_id=i,
                         lighthouse_address=lighthouse.address(),
                         failure_injector=inj,
-                        train_loop=hsdp_train_loop,
+                        train_loop=functools.partial(
+                            hsdp_train_loop, backend=backend
+                        ),
                     ).run_replica
                 )
                 for i, inj in enumerate(injectors)
@@ -97,20 +112,27 @@ def _run(injectors):
 
 
 def assert_equal_params(results):
+    # bit-identical, not allclose: lockstep replicas reduce and apply the
+    # exact same f32 values, the reference's integ tests assert state-dict
+    # equality (manager_integ_test.py:203-230) and so do we
     a, b = results[0][0]["params"], results[1][0]["params"]
     la, ta = jax.tree_util.tree_flatten(a)
     lb, tb = jax.tree_util.tree_flatten(b)
     assert ta == tb
     for x, y in zip(la, lb):
-        np.testing.assert_allclose(x, y, atol=1e-6)
+        np.testing.assert_array_equal(x, y)
 
 
-def test_hsdp_healthy():
-    results = _run([FailureInjector(), FailureInjector()])
+@pytest.mark.parametrize("backend", ["tcp", "device"])
+def test_hsdp_healthy(backend):
+    results = _run([FailureInjector(), FailureInjector()], backend=backend)
     assert_equal_params(results)
 
 
-def test_hsdp_recovery_sharded_heal():
+@pytest.mark.parametrize("backend", ["tcp", "device"])
+def test_hsdp_recovery_sharded_heal(backend):
     """Killed group heals its *sharded* params from the survivor."""
-    results = _run([FailureInjector(), FailureInjector().fail_at(0, 2)])
+    results = _run(
+        [FailureInjector(), FailureInjector().fail_at(0, 2)], backend=backend
+    )
     assert_equal_params(results)

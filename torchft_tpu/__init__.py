@@ -29,6 +29,7 @@ __all__ = [
     "DistributedSampler",
     "Collectives",
     "CollectivesTcp",
+    "CollectivesDevice",
     "CollectivesDummy",
     "ErrorSwallowingCollectives",
     "ManagedCollectives",
@@ -50,6 +51,10 @@ def __getattr__(name):
         from torchft_tpu.proxy import CollectivesProxy
 
         return CollectivesProxy
+    if name == "CollectivesDevice":
+        from torchft_tpu.collectives_device import CollectivesDevice
+
+        return CollectivesDevice
     if name == "FTTrainer":
         from torchft_tpu.parallel.ft import FTTrainer
 

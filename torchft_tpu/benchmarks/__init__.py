@@ -1,0 +1,9 @@
+"""Benchmark harnesses: recovery wall-clock, data-plane throughput.
+
+The reference ships benchmark *tooling* but publishes no numbers
+(BASELINE.md); its timing envelope lives in test assertions
+(torchft/lighthouse_test.py:44-47, manager_integ_test.py:325-368). These
+modules measure the same envelope — quorum-recovery wall-clock after a
+replica-group kill — as reusable harnesses shared by bench.py and the
+test suite.
+"""

@@ -1,0 +1,296 @@
+"""Recovery wall-clock measurement: kill one of two replica groups, time
+the survivor's blackout and the rejoiner's time-to-first-commit.
+
+BASELINE.md names "quorum-recovery wall-clock after killing 1 replica
+group" as the driver metric and "re-quorum in < 1 step" as the north star;
+the reference never measures it (its envelope lives in test assertions,
+lighthouse_test.py:44-47, manager_integ_test.py:325-368). This harness
+measures it for real, with real process kills:
+
+* two replica groups run as **subprocesses** (numpy data plane over
+  ``CollectivesTcp`` — hardware-independent; the TPU stays free for the
+  throughput bench in the parent),
+* at a chosen step, group 1 takes SIGKILL (no cleanup, no goodbye — its
+  manager server and heartbeats die with it),
+* group 1 is respawned fresh and heals from the survivor.
+
+Reported numbers (seconds, wall-clock):
+
+* ``survivor_blackout_s`` — last commit before the kill → first commit
+  after it, on the surviving group. Covers dead-peer detection (socket
+  deadline), the latched-error flush re-quorum, and the split-brain
+  guard's wait for the victim's heartbeat lease to lapse.
+* ``rejoin_to_commit_s`` — respawn exec → the rejoiner's first committed
+  step, covering store bootstrap, quorum join, live checkpoint heal, and
+  one training step.
+* ``steady_step_s`` — median healthy step time, so the blackout can be
+  read in reference units ("< N steps").
+
+The detection cadence is configurable; the defaults here use aggressive
+1 s leases (the reference's defaults — 5 s heartbeat timeout, 60 s op
+timeout — bound the same path, just slower).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+__all__ = ["measure_recovery", "RecoveryResult"]
+
+
+# ---------------------------------------------------------------------------
+# worker (subprocess entry: python -m torchft_tpu.benchmarks.recovery)
+# ---------------------------------------------------------------------------
+
+
+def _emit(log, **event) -> None:
+    event["t"] = time.time()
+    log.write(json.dumps(event) + "\n")
+    log.flush()
+
+
+def _worker() -> None:
+    """Numpy-only FT training loop; commits are timestamped to the event
+    log. Deliberately jax-free so killing it never disturbs the
+    accelerator held by the parent bench process."""
+    from datetime import timedelta
+
+    import numpy as np
+
+    from torchft_tpu.collectives import CollectivesTcp
+    from torchft_tpu.manager import Manager
+
+    gid = int(os.environ["REPLICA_GROUP_ID"])
+    total_steps = int(os.environ["TORCHFT_BENCH_STEPS"])
+    step_sleep = float(os.environ.get("TORCHFT_BENCH_STEP_SLEEP", "0.05"))
+    op_timeout = float(os.environ.get("TORCHFT_BENCH_OP_TIMEOUT", "1.0"))
+    log = open(os.environ["TORCHFT_EVENT_LOG"], "a")
+
+    params = {"w": np.zeros((256, 256), np.float32), "steps_seen": 0}
+
+    def state_dict() -> Dict[str, object]:
+        return {"w": params["w"].copy(), "steps_seen": params["steps_seen"]}
+
+    def load_state_dict(state) -> None:
+        params["w"] = np.asarray(state["w"]).copy()
+        params["steps_seen"] = int(state["steps_seen"])
+
+    manager = Manager(
+        collectives=CollectivesTcp(timeout=timedelta(seconds=op_timeout)),
+        load_state_dict=load_state_dict,
+        state_dict=state_dict,
+        min_replica_size=1,
+        replica_id=f"group{gid}_",
+        rank=0,
+        world_size=1,
+        timeout=timedelta(seconds=op_timeout),
+        quorum_timeout=timedelta(seconds=10),
+        connect_timeout=timedelta(seconds=10),
+    )
+    _emit(log, event="start", gid=gid, pid=os.getpid())
+    rng = np.random.default_rng(gid)
+    try:
+        while manager.current_step() < total_steps:
+            manager.start_quorum()
+            time.sleep(step_sleep)  # the "forward/backward" of this toy step
+            grad = rng.standard_normal(params["w"].shape).astype(np.float32)
+            manager.allreduce(grad).wait()
+            if manager.should_commit():
+                params["w"] -= 0.01 * grad
+                params["steps_seen"] += 1
+                _emit(
+                    log,
+                    event="commit",
+                    gid=gid,
+                    step=manager.current_step(),
+                    pid=os.getpid(),
+                )
+    finally:
+        manager.shutdown(wait=False)
+        _emit(log, event="exit", gid=gid, pid=os.getpid())
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RecoveryResult:
+    survivor_blackout_s: float
+    rejoin_to_commit_s: float
+    steady_step_s: float
+    survivor_steps_lost: int
+    total_steps: int
+
+    def as_dict(self) -> Dict[str, float]:
+        return {
+            "survivor_blackout_s": round(self.survivor_blackout_s, 3),
+            "rejoin_to_commit_s": round(self.rejoin_to_commit_s, 3),
+            "steady_step_s": round(self.steady_step_s, 4),
+            "blackout_steps": round(
+                self.survivor_blackout_s / max(self.steady_step_s, 1e-9), 1
+            ),
+            "survivor_steps_lost": self.survivor_steps_lost,
+        }
+
+
+def _spawn(gid: int, env_extra: Dict[str, str]) -> subprocess.Popen:
+    from torchft_tpu.store import StoreServer
+
+    store = StoreServer()
+    env = dict(os.environ)
+    env.update(env_extra)
+    env.update(
+        TORCHFT_STORE_ADDR=store.address(),
+        REPLICA_GROUP_ID=str(gid),
+        NUM_REPLICA_GROUPS="2",
+        RANK="0",
+        WORLD_SIZE="1",
+        # keep children off any accelerator the parent owns
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "torchft_tpu.benchmarks.recovery"],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    proc._torchft_store = store  # keep the store alive with the proc
+    return proc
+
+
+def _read_events(path: str) -> List[Dict]:
+    events = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+    except FileNotFoundError:
+        pass
+    return events
+
+
+def _wait_for(path: str, pred, timeout_s: float, procs=()) -> Dict:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        for e in _read_events(path):
+            if pred(e):
+                return e
+        for p in procs:
+            if p.poll() not in (None, 0):
+                raise RuntimeError(f"worker died early (rc={p.poll()})")
+        time.sleep(0.02)
+    raise TimeoutError("recovery bench: expected event never arrived")
+
+
+def measure_recovery(
+    total_steps: int = 30,
+    kill_at_step: int = 8,
+    step_sleep: float = 0.05,
+    op_timeout: float = 1.0,
+    heartbeat_timeout_ms: int = 1000,
+    timeout_s: float = 120.0,
+) -> RecoveryResult:
+    """Run the 2-group kill/heal scenario and measure the envelope."""
+    from torchft_tpu.coordination import LighthouseServer
+
+    tmp = tempfile.mkdtemp(prefix="tft_recovery_")
+    logs = [os.path.join(tmp, f"g{g}.jsonl") for g in range(2)]
+    lighthouse = LighthouseServer(
+        bind="[::]:0",
+        min_replicas=1,
+        join_timeout_ms=100,
+        heartbeat_timeout_ms=heartbeat_timeout_ms,
+    )
+    addr = lighthouse.address().split("//", 1)[-1]
+    common = {
+        "TORCHFT_LIGHTHOUSE": addr,
+        "TORCHFT_BENCH_STEPS": str(total_steps),
+        "TORCHFT_BENCH_STEP_SLEEP": str(step_sleep),
+        "TORCHFT_BENCH_OP_TIMEOUT": str(op_timeout),
+    }
+    procs: List[Optional[subprocess.Popen]] = [None, None]
+    try:
+        for g in range(2):
+            procs[g] = _spawn(g, {**common, "TORCHFT_EVENT_LOG": logs[g]})
+
+        # let both groups reach the kill step
+        _wait_for(
+            logs[1],
+            lambda e: e["event"] == "commit" and e["step"] >= kill_at_step,
+            timeout_s,
+            procs=[p for p in procs if p],
+        )
+        victim = procs[1]
+        t_kill = time.time()
+        victim.send_signal(signal.SIGKILL)
+        victim.wait()
+        victim._torchft_store.shutdown()
+
+        # respawn group 1 fresh (the launcher's restart, done by hand so the
+        # respawn time is known exactly)
+        t_respawn = time.time()
+        procs[1] = _spawn(1, {**common, "TORCHFT_EVENT_LOG": logs[1]})
+
+        # survivor's first commit after the kill
+        post = _wait_for(
+            logs[0],
+            lambda e: e["event"] == "commit" and e["t"] > t_kill,
+            timeout_s,
+            procs=[p for p in procs if p],
+        )
+        # rejoiner's first commit after respawn
+        rejoin = _wait_for(
+            logs[1],
+            lambda e: e["event"] == "commit" and e["t"] > t_respawn,
+            timeout_s,
+            procs=[p for p in procs if p],
+        )
+
+        for p in procs:
+            p.wait(timeout=timeout_s)
+
+        g0 = [e for e in _read_events(logs[0]) if e["event"] == "commit"]
+        pre = [e for e in g0 if e["t"] <= t_kill]
+        steady = [b["t"] - a["t"] for a, b in zip(pre, pre[1:])]
+        steady_step = sorted(steady)[len(steady) // 2] if steady else step_sleep
+        last_pre_t = pre[-1]["t"] if pre else t_kill
+        last_pre_step = pre[-1]["step"] if pre else kill_at_step
+        blackout = post["t"] - last_pre_t
+        # committed steps the survivor would have made during the blackout,
+        # minus the ones it did make: the "< 1 step" envelope in step units
+        lost = max(0, int(blackout / steady_step) - (post["step"] - last_pre_step))
+        return RecoveryResult(
+            survivor_blackout_s=blackout,
+            rejoin_to_commit_s=rejoin["t"] - t_respawn,
+            steady_step_s=steady_step,
+            survivor_steps_lost=lost,
+            total_steps=total_steps,
+        )
+    finally:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+            if p is not None:
+                p._torchft_store.shutdown()
+        lighthouse.shutdown()
+
+
+if __name__ == "__main__":
+    if "TORCHFT_EVENT_LOG" in os.environ:
+        _worker()
+    else:
+        print(json.dumps(measure_recovery().as_dict()))

@@ -670,6 +670,10 @@ class ErrorSwallowingCollectives(Collectives):
         self._inner = inner
         self._error: Optional[Exception] = None
 
+    @property
+    def device_arrays(self) -> bool:
+        return bool(getattr(self._inner, "device_arrays", False))
+
     def error(self) -> Optional[Exception]:
         return self._error
 

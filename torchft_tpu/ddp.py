@@ -82,18 +82,37 @@ def allreduce_gradients(
 ) -> Any:
     """Average a gradient pytree across replica groups through the Manager.
 
-    Device arrays are pulled to host, bucketed, allreduced via
-    ``manager.allreduce`` (which scales by ``1/num_participants()`` and
-    swallows errors into the latched state), and returned as a pytree of
-    numpy arrays — feed them straight into the jitted optimizer update,
-    XLA transfers them back to device.
+    Two paths, chosen by the Manager's configured data plane:
+
+    * **device path** (``CollectivesDevice`` — groups sharing one JAX
+      runtime): the ``jax.Array`` leaves go straight into
+      ``manager.allreduce_many``; the averaging is one jitted psum over the
+      'ft' mesh axis riding ICI and the gradients never touch the host.
+    * **host path** (``CollectivesTcp`` — groups in separate processes,
+      DCN): device arrays are pulled to host (async per-leaf D2H overlaps
+      the transfers), bucketed into ~25 MB flat buffers, ring-allreduced,
+      and returned as numpy — feed them straight into the jitted optimizer
+      update, XLA transfers them back to device.
+
+    Both scale by ``1/num_participants()`` and swallow errors into the
+    Manager's latched state.
     """
     import jax
 
-    from torchft_tpu.checkpointing.serialization import to_host_tree
+    leaves, treedef = _leaves(grads)
 
-    leaves, treedef = _leaves(to_host_tree(grads))
-    host = list(leaves)
+    if getattr(manager, "device_data_plane", lambda: False)():
+        out = manager.allreduce_many(leaves).wait()
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # overlap D2H across leaves before the first blocking np.asarray
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — prefetch is best-effort
+                break
+    host = [np.ascontiguousarray(np.asarray(l)) for l in leaves]
     buckets = flatten_buckets(host, bucket_bytes)
     futs = [manager.allreduce(buf) for buf, _ in buckets]
     for f in futs:

@@ -67,6 +67,25 @@ class WorldSizeMode(Enum):
     FIXED_WITH_SPARES = 1
 
 
+_DIV_JIT = None
+
+
+def _divide_tree(arrays: List[Any], n: int) -> List[Any]:
+    """One jitted kernel dividing every array by ``n`` (device path of
+    gradient normalization). ``n`` is a traced scalar so membership changes
+    never recompile; the jit caches per list structure/shapes."""
+    global _DIV_JIT
+    import jax
+
+    if _DIV_JIT is None:
+
+        def f(xs, n):
+            return [(x / n).astype(x.dtype) for x in xs]
+
+        _DIV_JIT = jax.jit(f)
+    return _DIV_JIT(arrays, np.float32(n))
+
+
 class _ManagerLogger:
     """Prefixes every line with ``[replica_id/rank - step N]``
     (manager.py:709-728)."""
@@ -374,35 +393,62 @@ class Manager:
     # collectives
     # ------------------------------------------------------------------
 
+    def device_data_plane(self) -> bool:
+        """True when the configured collectives move ``jax.Array``s directly
+        (ICI path, :class:`~torchft_tpu.collectives_device.CollectivesDevice`)
+        — gradient averaging then skips the host round trip entirely."""
+        return bool(getattr(self._collectives, "device_arrays", False))
+
     def allreduce(self, tensor: np.ndarray) -> Future:
-        """Fault-tolerant cross-replica-group allreduce of a host buffer,
-        scaled by ``1 / num_participants()``.
+        """Fault-tolerant cross-replica-group allreduce of one buffer,
+        scaled by ``1 / num_participants()``; see :meth:`allreduce_many`."""
+        return self.allreduce_many([tensor]).then(lambda f: f.value()[0])
+
+    def allreduce_many(self, tensors: List[Any]) -> Future:
+        """Fault-tolerant cross-replica-group allreduce of a list of
+        buffers (numpy, averaged in place — or ``jax.Array``s when the data
+        plane is device-path, averaged on device), scaled by
+        ``1 / num_participants()``.
 
         On error the future still completes (with the possibly-corrupt
-        tensor) and the error is latched — subsequent calls no-op and the
+        tensors) and the error is latched — subsequent calls no-op and the
         step fails at the commit barrier. Healing/spare replicas contribute
         zeros so the participants' average is unperturbed."""
-        if self.errored():
-            return Future.completed(tensor)
+        if not tensors or self.errored():
+            return Future.completed(tensors)
 
         self.wait_quorum()
 
+        # branch on the *configured* data plane, not the input type: the
+        # device backend converts numpy inputs to jax.Arrays, so its results
+        # must be normalized on device regardless of what the caller passed
+        device = self.device_data_plane()
         if not self.is_participating():
-            tensor[...] = 0
+            if device:
+                import jax.numpy as jnp
+
+                tensors = [jnp.zeros_like(t) for t in tensors]
+            else:
+                for t in tensors:
+                    t[...] = 0  # in place: host buffers are bucket views
 
         try:
-            work = self._collectives.allreduce([tensor], ReduceOp.SUM)
+            work = self._collectives.allreduce(tensors, ReduceOp.SUM)
 
-            def normalize(fut: Future) -> np.ndarray:
-                fut.value()  # surface exceptions
-                np.divide(tensor, self.num_participants(), out=tensor)
-                return tensor
+            def normalize(fut: Future) -> List[Any]:
+                reduced = fut.value()  # surface exceptions
+                n = self.num_participants()
+                if device:
+                    return _divide_tree(reduced, n)
+                for t in reduced:
+                    np.divide(t, n, out=t)
+                return reduced
 
-            return self.wrap_future(work.get_future().then(normalize), tensor)
+            return self.wrap_future(work.get_future().then(normalize), tensors)
         except Exception as e:  # noqa: BLE001 — latch and continue
             self._logger.exception(f"exception in allreduce, skipping remaining: {e}")
             self.report_error(e)
-            return Future.completed(tensor)
+            return Future.completed(tensors)
 
     def report_error(self, e: Exception) -> None:
         """Latch an error: the current step will not commit and the data

@@ -188,11 +188,14 @@ def _use_flash(cfg: TransformerConfig, seq_len: int) -> bool:
         raise ValueError(
             f"attention_impl must be 'auto'|'plain'|'flash', got {cfg.attention_impl!r}"
         )
-    # auto: the pallas kernel's O(S/blocks) memory is what makes long
-    # sequences compile at all; at short S XLA's fused attention is faster
+    # auto: the pallas kernel's O(S·block) memory is what makes very long
+    # sequences fit at all; below that XLA's fused attention is faster
+    # (measured on v5e: XLA fused ~10x the pallas kernel's throughput at
+    # S=4096 — jax's own library flash kernel measures the same, so the
+    # crossover is where the materialized [S,S] scores stop fitting HBM)
     return (
         jax.default_backend() == "tpu"
-        and seq_len >= 4096
+        and seq_len >= 8192
         and seq_len % 128 == 0
     )
 
@@ -211,7 +214,11 @@ def _flash_sharded(q, k, v, mesh):
         lambda q, k, v: flash_attention(q, k, v, causal=True),
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        axis_names={"dp", "fsdp", "tp"},
+        # ALL mesh axes must be manual here: any axis left auto keeps the
+        # region under the SPMD partitioner, which refuses Mosaic calls
+        # even at axis size 1 (tpu_custom_call "cannot be automatically
+        # partitioned"). Axes beyond dp/fsdp/tp are replicated by the spec.
+        axis_names=set(mesh.axis_names),
         # pallas_call's out_shape carries no varying-manual-axes type, which
         # the VMA checker would require; the kernel is per-shard local so
         # the check adds nothing here
@@ -239,7 +246,13 @@ def _make_layer_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
             att = ring_attention_local(q, k, v, sp_size, causal=True)
         elif sp_size > 1:
             att = ring_attention(q, k, v, mesh, causal=True)
-        elif _use_flash(cfg, s):
+        elif _use_flash(cfg, s) and not sp_manual and (
+            mesh is None or mesh.shape.get("pp", 1) == 1
+        ):
+            # flash needs its own (full) manual region, which can't nest
+            # inside the pipeline's partial-manual shard_map (Shardy rejects
+            # nested manual regions) — pp>1 long-context should shard the
+            # sequence (sp), which routes to ring attention above
             att = _flash_sharded(q, k, v, mesh)
         else:
             att = attention(q, k, v, causal=True)
