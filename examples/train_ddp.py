@@ -8,6 +8,8 @@ Mesh — see torchft_tpu.parallel). Configure via env:
     REPLICA_GROUP_ID=0             this group's id
     NUM_REPLICA_GROUPS=2           total groups (min replicas = 2 here)
     STEPS=20                       steps to train
+    CKPT_DIR=/path                 enable periodic disk checkpoints there
+    CKPT_EVERY=5                   checkpoint cadence (committed steps)
 
 Run a 2-group session (3 terminals)::
 
@@ -17,6 +19,14 @@ Run a 2-group session (3 terminals)::
 
 Kill either trainer mid-run and restart it: it rejoins the quorum and
 live-heals from the survivor, costing the cohort at most one step.
+
+Two complementary recovery mechanisms, as in the reference: the live
+quorum heal above covers *partial* failures (a peer survives to serve
+state), and the periodic disk checkpoint covers *total* failures — with
+CKPT_DIR set, every CKPT_EVERY committed steps the group writes
+{manager state, params+optimizer, sampler position} atomically
+(reference workflow: train_ddp.py:141-148, manager.py:83-85 docs) and a
+restarted process resumes from it automatically, continuing bit-exactly.
 """
 
 import logging
@@ -75,6 +85,11 @@ def main() -> None:
     num_groups = int(os.environ.get("NUM_REPLICA_GROUPS", 2))
     steps = int(os.environ.get("STEPS", 20))
     batch = int(os.environ.get("BATCH", 64))
+    ckpt_dir = os.environ.get("CKPT_DIR")
+    ckpt_every = int(os.environ.get("CKPT_EVERY", 5))
+    ckpt_path = (
+        os.path.join(ckpt_dir, f"group{replica_group}.ckpt") if ckpt_dir else None
+    )
 
     store = StoreServer()
     manager = Manager(
@@ -92,17 +107,49 @@ def main() -> None:
     x, y = make_dataset()
     opt = ManagedOptimizer(manager, optax.adam(1e-3))
     opt.init(init_params())
+    sampler = DistributedSampler(
+        len(x),
+        replica_group=replica_group,
+        num_replica_groups=num_groups,
+        shuffle=True,
+        seed=0,
+    )
     value_and_grad = jax.jit(jax.value_and_grad(loss_fn))
+
+    # resume from the periodic disk checkpoint if one exists (total-failure
+    # recovery; live quorum healing covers partial failures). Loading
+    # BEFORE the first quorum makes the group report its true step, so a
+    # resumed group that is behind the cohort heals forward, never back.
+    if ckpt_path and os.path.exists(ckpt_path):
+        from torchft_tpu.checkpointing.serialization import load_state
+
+        with open(ckpt_path, "rb") as f:
+            ckpt = load_state(f)
+        manager.load_state_dict(ckpt["torchft"])
+        opt.load_state_dict(ckpt["user"])
+        sampler.load_state_dict(ckpt["sampler"])
+        logger.info("resumed from %s at step %d", ckpt_path, manager.current_step())
+
+    last_saved_step = manager.current_step()
+
+    def save_checkpoint() -> None:
+        from torchft_tpu.checkpointing.serialization import save_state
+
+        tmp = ckpt_path + ".tmp"
+        with open(tmp, "wb") as f:
+            save_state(
+                {
+                    "torchft": manager.state_dict(),
+                    "user": opt.state_dict(),
+                    "sampler": sampler.state_dict(),
+                },
+                f,
+            )
+        os.replace(tmp, ckpt_path)  # atomic: a crash mid-write keeps the old one
+        logger.info("checkpointed step %d to %s", manager.current_step(), ckpt_path)
 
     try:
         while manager.current_step() < steps:
-            sampler = DistributedSampler(
-                len(x),
-                replica_group=replica_group,
-                num_replica_groups=num_groups,
-                shuffle=True,
-                seed=0,
-            )
             sampler.set_epoch(manager.current_step())
             idx = np.fromiter(iter(sampler), dtype=np.int64)[:batch]
 
@@ -116,6 +163,13 @@ def main() -> None:
                 manager.num_participants(),
                 float(loss),
             )
+            if (
+                ckpt_path
+                and manager.current_step() % ckpt_every == 0
+                and manager.current_step() > last_saved_step  # only on progress
+            ):
+                save_checkpoint()
+                last_saved_step = manager.current_step()
         final = jax.tree_util.tree_map(lambda a: np.asarray(a).sum(), opt.params)
         logger.info("done: step=%d param_checksum=%.6f",
                     manager.current_step(),

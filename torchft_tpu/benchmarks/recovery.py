@@ -150,6 +150,14 @@ def _spawn(gid: int, env_extra: Dict[str, str]) -> subprocess.Popen:
     store = StoreServer()
     env = dict(os.environ)
     env.update(env_extra)
+    # the package may be run from a checkout (no pip install): make it
+    # importable in the child no matter the parent's cwd
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_parent, env.get("PYTHONPATH")) if p
+    )
     env.update(
         TORCHFT_STORE_ADDR=store.address(),
         REPLICA_GROUP_ID=str(gid),

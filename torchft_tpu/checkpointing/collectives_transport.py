@@ -17,6 +17,7 @@ import numpy as np
 
 from torchft_tpu.checkpointing.serialization import (
     as_bytes,
+    buffer_sizes,
     flatten_state,
     unflatten_state,
 )
@@ -69,10 +70,8 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
 
         _, infos = pickle.loads(header)
         buffers: List[np.ndarray] = []
-        for info in infos:
-            if info[0] != "arr":
-                continue
-            buf = np.zeros(info[3], dtype=np.uint8)
+        for nbytes in buffer_sizes(infos):
+            buf = np.zeros(nbytes, dtype=np.uint8)
             self._collectives.recv(buf, src_rank, tag=_DATA_TAG).wait(timeout)
             buffers.append(buf)
         return unflatten_state(header, buffers)

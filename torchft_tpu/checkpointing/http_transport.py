@@ -234,13 +234,15 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
 
                 return load_state(resp)
         _, infos = pickle.loads(header)
-        arr_infos = [i for i in infos if i[0] == "arr"]
-        buffers: List[Optional[np.ndarray]] = [None] * len(arr_infos)
+        from torchft_tpu.checkpointing.serialization import buffer_sizes
+
+        sizes = buffer_sizes(infos)
+        buffers: List[Optional[np.ndarray]] = [None] * len(sizes)
 
         def fetch(ci: int) -> None:
             with urllib.request.urlopen(f"{base}/chunk_{ci}", timeout=secs) as r:
                 for j in groups[ci]:
-                    nbytes = arr_infos[j][3]
+                    nbytes = sizes[j]
                     raw = r.read(nbytes)
                     if len(raw) != nbytes:
                         raise EOFError(f"truncated chunk {ci}")

@@ -15,9 +15,20 @@ Wire layout::
 
     u64 header_len | pickle((treedef, leaf_infos)) | raw buffers...
 
-where ``leaf_infos[i]`` is ``("arr", dtype_str, shape, nbytes)`` for array
-leaves (buffer follows in order) or ``("obj", pickled_bytes)`` for
-non-array leaves (inline, no buffer).
+where ``leaf_infos[i]`` is one of
+
+* ``("arr", dtype_str, shape, nbytes)`` — dense array leaf (one buffer);
+* ``("shards", dtype_str, global_shape, mesh_desc, spec_entries,
+  [(index_desc, nbytes), ...])`` — a sharded ``jax.Array`` leaf shipped
+  **per shard** (one buffer per distinct shard): the NamedSharding
+  analogue of the reference's DTensor-spec transfer
+  (pg_transport.py:104-114, 217-247). Only this process's addressable
+  shards travel, deduplicated by shard index (replicated copies ship
+  once), so a sharded group never gathers the full model onto one host
+  and multi-host groups each contribute their own shards. The receiver
+  gets a :class:`ShardedArray` placeholder and rebuilds the device array
+  on its own congruent mesh via :func:`from_transfer_tree`;
+* ``("obj", pickled_bytes)`` — non-array leaf (inline, no buffer).
 """
 
 from __future__ import annotations
@@ -31,7 +42,15 @@ import numpy as np
 
 _LEN = struct.Struct("<Q")
 
-__all__ = ["flatten_state", "unflatten_state", "save_state", "load_state"]
+__all__ = [
+    "flatten_state",
+    "unflatten_state",
+    "save_state",
+    "load_state",
+    "buffer_sizes",
+    "ShardedArray",
+    "from_transfer_tree",
+]
 
 
 def _tree_util():
@@ -96,6 +115,117 @@ def _resolve_dtype(name: str) -> np.dtype:
         return np.dtype(name)
 
 
+# ---------------------------------------------------------------------------
+# sharded leaves (NamedSharding descriptor — the DTensor-spec analogue)
+# ---------------------------------------------------------------------------
+
+
+def _index_desc(index: Tuple, shape: Tuple[int, ...]) -> Tuple:
+    """Canonicalize a shard's index (tuple of slices) into nested
+    ``(start, stop)`` pairs that pickle cleanly and compare by value."""
+    out = []
+    for sl, n in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = n if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _sharding_desc(arr) -> Any:
+    """``(axis_names, mesh_shape, spec_entries)`` for a NamedSharding-ed
+    jax.Array spanning >1 device, else None (dense path)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    s = getattr(arr, "sharding", None)
+    if not isinstance(s, NamedSharding):
+        return None
+    if len(s.mesh.devices.flat) <= 1:
+        return None
+    return (
+        tuple(s.mesh.axis_names),
+        tuple(s.mesh.devices.shape),
+        tuple(s.spec),
+    )
+
+
+class ShardedArray:
+    """Host-side carrier for a sharded ``jax.Array`` in transit: global
+    shape/dtype, the sender's mesh/spec descriptor, and its (deduplicated)
+    addressable shards. Rebuild on the receiver with :meth:`to_jax` against
+    a congruent local mesh, or assemble densely with :meth:`full`."""
+
+    def __init__(
+        self,
+        dtype: np.dtype,
+        shape: Tuple[int, ...],
+        mesh_desc: Tuple,
+        spec_entries: Tuple,
+        shards: List[Tuple[Tuple, np.ndarray]],
+    ) -> None:
+        self.dtype = dtype
+        self.shape = tuple(shape)
+        self.mesh_desc = mesh_desc
+        self.spec_entries = spec_entries
+        self.shards = shards  # [(index_desc, host_array), ...]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(s.nbytes for _, s in self.shards)
+
+    def to_jax(self, mesh):
+        """Place the shards onto ``mesh`` with the sender's PartitionSpec.
+        The mesh must be congruent (same axis names/sizes for the sharded
+        axes); each local device receives exactly its shard — no dense
+        intermediate, no cross-device gather."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(mesh, PartitionSpec(*self.spec_entries))
+        by_index = {idx: data for idx, data in self.shards}
+        arrays = []
+        for dev, index in sharding.addressable_devices_indices_map(
+            self.shape
+        ).items():
+            data = by_index.get(_index_desc(index, self.shape))
+            if data is None:
+                raise ValueError(
+                    f"missing shard {index} for leaf {self.shape}; sender "
+                    f"mesh {self.mesh_desc} is not congruent with the local mesh"
+                )
+            arrays.append(jax.device_put(data, dev))
+        return jax.make_array_from_single_device_arrays(
+            self.shape, sharding, arrays
+        )
+
+    def full(self) -> np.ndarray:
+        """Assemble a dense host array (fallback when no mesh is at hand —
+        requires the sender's shards to cover the global array)."""
+        out = np.empty(self.shape, dtype=self.dtype)
+        covered = 0
+        for idx, data in self.shards:
+            sl = tuple(slice(a, b) for a, b in idx)
+            out[sl] = data
+            covered += data.size
+        if covered < int(np.prod(self.shape)):
+            raise ValueError(
+                "shards do not cover the array (multi-host sender); "
+                "rebuild with to_jax(mesh) instead"
+            )
+        return out
+
+
+def from_transfer_tree(tree: Any, mesh) -> Any:
+    """Convert every :class:`ShardedArray` leaf back into a ``jax.Array``
+    on ``mesh`` (the receiver-side half of the sharded transfer)."""
+    tu = _tree_util()
+    return tu.tree_map(
+        lambda l: l.to_jax(mesh) if isinstance(l, ShardedArray) else l,
+        tree,
+        is_leaf=lambda l: isinstance(l, ShardedArray),
+    )
+
+
 def flatten_state(state: Any) -> Tuple[bytes, List[np.ndarray]]:
     """Flatten a pytree into ``(header_bytes, array_buffers)``."""
     leaves, treedef = _tree_util().tree_flatten(state)
@@ -103,17 +233,54 @@ def flatten_state(state: Any) -> Tuple[bytes, List[np.ndarray]]:
     buffers: List[np.ndarray] = []
     for leaf in leaves:
         if _is_array(leaf):
-            host = _to_host(leaf)
-            infos.append(("arr", _dtype_name(host.dtype), host.shape, host.nbytes))
-            buffers.append(host)
+            desc = _sharding_desc(leaf)
+            if desc is not None:
+                axis_names, mesh_shape, spec_entries = desc
+                seen = {}
+                for s in leaf.addressable_shards:
+                    idx = _index_desc(s.index, leaf.shape)
+                    if idx not in seen:  # replicas ship once
+                        seen[idx] = _to_host(s.data)
+                shard_meta = [(idx, a.nbytes) for idx, a in seen.items()]
+                infos.append(
+                    (
+                        "shards",
+                        _dtype_name(np.dtype(leaf.dtype)),
+                        tuple(leaf.shape),
+                        (axis_names, mesh_shape),
+                        spec_entries,
+                        shard_meta,
+                    )
+                )
+                buffers.extend(seen.values())
+            else:
+                host = _to_host(leaf)
+                infos.append(
+                    ("arr", _dtype_name(host.dtype), host.shape, host.nbytes)
+                )
+                buffers.append(host)
         else:
             infos.append(("obj", pickle.dumps(leaf)))
     header = pickle.dumps((treedef, infos))
     return header, buffers
 
 
+def buffer_sizes(infos: List[Tuple]) -> List[int]:
+    """Byte size of every raw buffer that follows the header, in stream
+    order (the transports' manifest for chunked / per-buffer transfer)."""
+    sizes: List[int] = []
+    for info in infos:
+        if info[0] == "arr":
+            sizes.append(info[3])
+        elif info[0] == "shards":
+            sizes.extend(n for _, n in info[5])
+    return sizes
+
+
 def unflatten_state(header: bytes, buffers: List[np.ndarray]) -> Any:
-    """Inverse of :func:`flatten_state`."""
+    """Inverse of :func:`flatten_state`. Sharded leaves come back as
+    :class:`ShardedArray` placeholders — pass the tree through
+    :func:`from_transfer_tree` (or call ``.full()``) to materialize."""
     treedef, infos = pickle.loads(header)
     leaves: List[Any] = []
     it = iter(buffers)
@@ -122,6 +289,21 @@ def unflatten_state(header: bytes, buffers: List[np.ndarray]) -> Any:
             _, dtype, shape, _ = info
             buf = next(it)
             leaves.append(np.frombuffer(buf, dtype=_resolve_dtype(dtype)).reshape(shape))
+        elif info[0] == "shards":
+            _, dtype, shape, mesh_desc, spec_entries, shard_meta = info
+            np_dtype = _resolve_dtype(dtype)
+            shards = []
+            for idx, _nbytes in shard_meta:
+                shard_shape = tuple(b - a for a, b in idx)
+                shards.append(
+                    (
+                        idx,
+                        np.frombuffer(next(it), dtype=np_dtype).reshape(shard_shape),
+                    )
+                )
+            leaves.append(
+                ShardedArray(np_dtype, shape, mesh_desc, spec_entries, shards)
+            )
         else:
             leaves.append(pickle.loads(info[1]))
     return _tree_util().tree_unflatten(treedef, leaves)
@@ -142,13 +324,11 @@ def load_state(f: BinaryIO) -> Any:
     header = f.read(header_len)
     _, infos = pickle.loads(header)
     buffers: List[np.ndarray] = []
-    for info in infos:
-        if info[0] == "arr":
-            nbytes = info[3]
-            raw = f.read(nbytes)
-            if len(raw) != nbytes:
-                raise EOFError("truncated checkpoint stream")
-            buffers.append(np.frombuffer(raw, dtype=np.uint8))
+    for nbytes in buffer_sizes(infos):
+        raw = f.read(nbytes)
+        if len(raw) != nbytes:
+            raise EOFError("truncated checkpoint stream")
+        buffers.append(np.frombuffer(raw, dtype=np.uint8))
     return unflatten_state(header, buffers)
 
 

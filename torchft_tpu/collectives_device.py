@@ -234,7 +234,10 @@ class CollectivesDevice(Collectives):
             ep.fail_pending(
                 RuntimeError("collectives reconfigured before op completed")
             )
-            all_gone = ep.left >= ep.joined and len(ep.left) >= ep.world
+            # delete once every member that ever joined has left — members
+            # that never joined (peer crashed before configure) must not
+            # pin the epoch in the registry forever
+            all_gone = ep.left >= ep.joined
         if all_gone:
             with _REGISTRY_LOCK:
                 if _REGISTRY.get(ep.key) is ep:
@@ -389,21 +392,28 @@ def _as_device(arr: Any):
 # ---------------------------------------------------------------------------
 
 
-def _stack_over_ft(per_rank: Dict[int, Any], idx: int):
+def _stack_over_ft(per_rank: Dict[int, Any], idx: int, big_mesh=None):
     """Build (global_array, big_mesh, global_spec, per-rank shardings) for
-    the idx-th array of each rank, stacked on a leading 'ft' mesh axis."""
+    the idx-th array of each rank, stacked on a leading 'ft' mesh axis.
+    Pass a previously-built ``big_mesh`` to reuse it across leaves (every
+    leaf of one op spans the same devices)."""
     import jax
+    import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
     _congruent(per_rank, idx)
     ranks = sorted(per_rank)
     arrs = [per_rank[r][idx] for r in ranks]
-    devs0, names0, spec0 = _devices_and_spec(arrs[0])
-    big_devs = np.stack([_devices_and_spec(a)[0] for a in arrs])
-    big_mesh = Mesh(big_devs, ("ft", *names0))
+    _devs0, names0, spec0 = _devices_and_spec(arrs[0])
+    if big_mesh is None:
+        big_devs = np.stack([_devices_and_spec(a)[0] for a in arrs])
+        big_mesh = Mesh(big_devs, ("ft", *names0))
+    elif big_mesh.axis_names != ("ft", *names0):
+        raise RuntimeError(
+            "collective desync: arrays within one allreduce span "
+            "different meshes"
+        )
     gspec = PartitionSpec("ft", *spec0)
-    import jax.numpy as jnp
-
     shards = []
     for a in arrs:
         for s in a.addressable_shards:
@@ -444,19 +454,12 @@ def _compute_allreduce(inputs: Dict[int, List[Any]], meta: Tuple) -> Dict[int, A
     garrs, specs, all_shardings, all_devices = [], [], [], []
     big_mesh = None
     for i in range(n):
-        g, m, spec, shardings = _stack_over_ft(inputs, i)
-        if big_mesh is None:
-            big_mesh = m
-        elif m != big_mesh:
-            raise RuntimeError(
-                "collective desync: arrays within one allreduce span "
-                "different meshes"
-            )
+        g, big_mesh, spec, shardings = _stack_over_ft(inputs, i, big_mesh)
         garrs.append(g)
         specs.append(spec)
         all_shardings.append(shardings)
         all_devices.append(
-            [list(_devices_and_spec(inputs[r][i])[0].flat) for r in ranks]
+            [[s.device for s in inputs[r][i].addressable_shards] for r in ranks]
         )
 
     fn = _reduction_fn(big_mesh, tuple(specs), op, world)

@@ -246,14 +246,24 @@ def _make_layer_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
             att = ring_attention_local(q, k, v, sp_size, causal=True)
         elif sp_size > 1:
             att = ring_attention(q, k, v, mesh, causal=True)
-        elif _use_flash(cfg, s) and not sp_manual and (
-            mesh is None or mesh.shape.get("pp", 1) == 1
-        ):
+        elif _use_flash(cfg, s):
             # flash needs its own (full) manual region, which can't nest
             # inside the pipeline's partial-manual shard_map (Shardy rejects
             # nested manual regions) — pp>1 long-context should shard the
             # sequence (sp), which routes to ring attention above
-            att = _flash_sharded(q, k, v, mesh)
+            inside_manual = sp_manual or (
+                mesh is not None and mesh.shape.get("pp", 1) > 1
+            )
+            if inside_manual:
+                if cfg.attention_impl == "flash":
+                    raise ValueError(
+                        "attention_impl='flash' cannot run inside the "
+                        "pipeline's manual region (pp>1); shard the sequence "
+                        "(sp>1, ring attention) for long context under pp"
+                    )
+                att = attention(q, k, v, causal=True)  # auto: quiet fallback
+            else:
+                att = _flash_sharded(q, k, v, mesh)
         else:
             att = attention(q, k, v, causal=True)
         x = x + att.reshape(b, s, cfg.qkv_dim) @ lp["wo"]

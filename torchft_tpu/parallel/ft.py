@@ -23,7 +23,6 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from torchft_tpu.checkpointing.serialization import to_host_tree
 from torchft_tpu.ddp import allreduce_gradients
 from torchft_tpu.manager import Manager
 from torchft_tpu.parallel.train_step import TrainStep
@@ -54,19 +53,21 @@ class FTTrainer:
         return self._opt_state
 
     def state_dict(self) -> Dict[str, Any]:
-        # host-side snapshot: on multi-host meshes each process contributes
-        # its addressable shards; here the full gather is the transferable
-        # representation for the checkpoint transports
-        return {
-            "params": to_host_tree(self._params),
-            "opt_state": to_host_tree(self._opt_state),
-        }
+        # hand the raw sharded jax.Arrays to the transports: flatten_state
+        # ships each leaf per shard with its NamedSharding descriptor
+        # (serialization.py "shards" infos — the DTensor-spec analogue,
+        # pg_transport.py:104-114), so a sharded group never gathers the
+        # full model onto one host and replicated copies ship once
+        return {"params": self._params, "opt_state": self._opt_state}
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         import jax
 
-        # re-place the recovered host arrays onto the inner mesh with the
-        # step's shardings (GSPMD re-shards on first use otherwise)
+        from torchft_tpu.checkpointing.serialization import from_transfer_tree
+
+        # rebuild sharded leaves shard-by-shard on this group's mesh, then
+        # pin params to the step's shardings (no-op when already placed)
+        state = from_transfer_tree(state, self._ts.mesh)
         self._params = jax.device_put(
             state["params"], self._ts._param_shardings
         )
