@@ -87,20 +87,41 @@ def main() -> None:
     batch = int(os.environ.get("BATCH", 64))
     ckpt_dir = os.environ.get("CKPT_DIR")
     ckpt_every = int(os.environ.get("CKPT_EVERY", 5))
-    ckpt_path = (
-        os.path.join(ckpt_dir, f"group{replica_group}.ckpt") if ckpt_dir else None
-    )
+    # launcher env contract (torchelastic analogue): a launcher-provided
+    # store + RANK/WORLD_SIZE means this process is one rank of a
+    # multi-process group; standalone runs make their own 1-rank group
+    rank = int(os.environ.get("RANK", 0))
+    world_size = int(os.environ.get("WORLD_SIZE", 1))
+    store_addr = os.environ.get("TORCHFT_STORE_ADDR")
+    store = None
+    if store_addr is None:
+        store = StoreServer()
+        store_addr = store.address()
+    # multi-host group: join the group-wide jax runtime (no-op without
+    # TORCHFT_JAX_COORDINATOR); this example keeps compute replicated per
+    # rank — a sharded inner mesh is what torchft_tpu.parallel is for
+    from torchft_tpu.parallel.multihost import initialize_group
 
-    store = StoreServer()
+    initialize_group()
+    # ONE checkpoint per group, written by rank 0 and loaded by every rank:
+    # ranks are replicated here, and a shared file + atomic os.replace means
+    # all ranks of a restarted group resume from the same step no matter
+    # when the kill landed (per-rank files could tear mid-save and silently
+    # diverge the group's rank planes)
+    ckpt_path = None
+    if ckpt_dir:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        ckpt_path = os.path.join(ckpt_dir, f"group{replica_group}.ckpt")
+
     manager = Manager(
         collectives=CollectivesTcp(timeout=timedelta(seconds=30)),
         load_state_dict=None,  # wired by ManagedOptimizer.init
         state_dict=None,
         min_replica_size=min(2, num_groups),
         replica_id=f"train_ddp_{replica_group}",
-        store_addr=store.address(),
-        rank=0,
-        world_size=1,
+        store_addr=store_addr,
+        rank=rank,
+        world_size=world_size,
         timeout=timedelta(seconds=30),
     )
 
@@ -165,6 +186,7 @@ def main() -> None:
             )
             if (
                 ckpt_path
+                and rank == 0  # one writer per group; all ranks resume from it
                 and manager.current_step() % ckpt_every == 0
                 and manager.current_step() > last_saved_step  # only on progress
             ):
@@ -176,7 +198,8 @@ def main() -> None:
                     float(sum(float(v) for v in jax.tree_util.tree_leaves(final))))
     finally:
         manager.shutdown(wait=False)
-        store.shutdown()
+        if store is not None:
+            store.shutdown()
 
 
 if __name__ == "__main__":

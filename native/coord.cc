@@ -90,6 +90,7 @@ Value ManagerQuorumResult::to_value() const {
   v.set("replica_rank", Value::I(replica_rank));
   v.set("replica_world_size", Value::I(replica_world_size));
   v.set("heal", Value::B(heal));
+  v.set("group_heal", Value::B(group_heal));
   return v;
 }
 
@@ -257,9 +258,29 @@ ManagerQuorumResult compute_quorum_results(const std::string& replica_id,
       recover_src_rank = (int64_t)src;
   }
 
+  // group_heal: does ANY local rank of this replica heal this round?
+  // Participation (zero-contribution) must be decided at group level —
+  // per-rank heal flags differ across rank planes at the max_step==0
+  // striped bootstrap, and rank planes averaging different participant
+  // sets would silently diverge a multi-rank group's replicated or
+  // sharded state. (The reference gates participation on the per-rank
+  // flag, manager.py:268-269, which is only sound for 1-rank groups.)
+  const QuorumMember& me = participants[(size_t)replica_rank];
+  bool group_heal = me.step != max_step;
+  if (!group_heal && max_step == 0) {
+    uint64_t local_world = me.world_size ? me.world_size : 1;
+    uint64_t planes = std::min<uint64_t>(local_world, max_idx.size());
+    for (uint64_t r = 0; r < planes && !group_heal; ++r) {
+      const QuorumMember& prim_r =
+          participants[max_idx[(size_t)r % max_idx.size()]];
+      if (prim_r.replica_id != replica_id) group_heal = true;
+    }
+  }
+
   ManagerQuorumResult out;
   out.quorum_id = quorum.quorum_id;
   out.heal = recover_src_rank.has_value();
+  out.group_heal = group_heal;
   out.recover_src_rank = recover_src_rank;
   if (recover_src_rank.has_value())
     out.recover_src_manager_address =

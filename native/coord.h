@@ -69,7 +69,11 @@ struct ManagerQuorumResult {
   int64_t max_world_size = 0;
   int64_t replica_rank = 0;
   int64_t replica_world_size = 0;
-  bool heal = false;
+  bool heal = false;       // this rank fetches recovery state
+  bool group_heal = false; // any local rank heals → the whole group
+                           // contributes zeros (participation gate must be
+                           // rank-plane-consistent; extension beyond the
+                           // reference's per-rank flag, manager.py:268-269)
 
   Value to_value() const;
 };

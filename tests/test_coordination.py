@@ -258,6 +258,32 @@ class TestComputeQuorumResults:
         with pytest.raises(RuntimeError):
             _native.compute_quorum_results(q, "zz", 0)
 
+    def test_group_heal_is_plane_consistent(self):
+        """Participation gating must agree across a group's rank planes:
+        with 2-rank groups at the step-0 striped bootstrap, EVERY group has
+        a healing rank somewhere, so every (group, rank) reports
+        group_heal — otherwise plane 0 would average real gradients while
+        plane 1 averages zeros and replicated/sharded state diverges
+        (extension beyond the reference's per-rank gate, manager.py:268)."""
+        q = quorum(
+            1, [member("a", 0, world_size=2), member("b", 0, world_size=2)]
+        )
+        for rid in ("a", "b"):
+            for rank in (0, 1):
+                r = _native.compute_quorum_results(q, rid, rank)
+                assert r["group_heal"] is True, (rid, rank)
+        # per-rank heal still stripes (it drives WHO fetches state)
+        assert _native.compute_quorum_results(q, "a", 0)["heal"] is False
+        assert _native.compute_quorum_results(q, "a", 1)["heal"] is True
+
+    def test_group_heal_matches_heal_for_single_rank_groups(self):
+        q0 = quorum(1, [member("a", 0), member("b", 0)])
+        qk = quorum(7, [member("a", 5), member("b", 3)])
+        for q in (q0, qk):
+            for rid in ("a", "b"):
+                r = _native.compute_quorum_results(q, rid, 0)
+                assert r["group_heal"] == r["heal"], (rid, r)
+
 
 class TestLighthouseE2E:
     def test_quorum_fast_latency(self):

@@ -44,6 +44,9 @@ class QuorumResult:
     max_rank: Optional[int] = None
     max_world_size: int = 1
     heal: bool = False
+    # any local rank of this group heals → the group contributes zeros on
+    # every rank plane (participation must be plane-consistent)
+    group_heal: bool = False
 
     @staticmethod
     def _from_wire(d: Dict[str, Any]) -> "QuorumResult":
@@ -59,6 +62,7 @@ class QuorumResult:
             max_rank=d.get("max_rank"),
             max_world_size=d.get("max_world_size", 1),
             heal=d.get("heal", False),
+            group_heal=d.get("group_heal", d.get("heal", False)),
         )
 
 

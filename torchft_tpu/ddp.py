@@ -105,17 +105,65 @@ def allreduce_gradients(
         out = manager.allreduce_many(leaves).wait()
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    # overlap D2H across leaves before the first blocking np.asarray
-    for leaf in leaves:
-        if isinstance(leaf, jax.Array):
-            try:
+    # host path. A leaf sharded across processes (multi-host group) cannot
+    # be gathered: this process averages only its addressable shards —
+    # correct because same-rank peers across groups hold the same shard
+    # indices (congruent meshes), and replicas within the process are
+    # averaged once and re-placed to every holder.
+    from torchft_tpu.checkpointing.serialization import _index_desc
+
+    # overlap D2H across leaves before the first blocking np.asarray —
+    # for process-spanning leaves, prefetch each local shard
+    try:
+        for leaf in leaves:
+            if not isinstance(leaf, jax.Array):
+                continue
+            if leaf.is_fully_addressable:
                 leaf.copy_to_host_async()
-            except Exception:  # noqa: BLE001 — prefetch is best-effort
-                break
-    host = [np.ascontiguousarray(np.asarray(l)) for l in leaves]
+            else:
+                for s in leaf.addressable_shards:
+                    s.data.copy_to_host_async()
+    except Exception:  # noqa: BLE001 — prefetch is best-effort
+        pass
+
+    host: List[np.ndarray] = []
+    rebuild: List[Tuple] = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            seen = {}
+            for s in leaf.addressable_shards:
+                idx = _index_desc(s.index, leaf.shape)
+                if idx not in seen:
+                    seen[idx] = np.ascontiguousarray(np.asarray(s.data))
+            rebuild.append(("shards", leaf, list(seen.keys())))
+            host.extend(seen.values())
+        else:
+            rebuild.append(("dense",))
+            host.append(np.ascontiguousarray(np.asarray(leaf)))
+
     buckets = flatten_buckets(host, bucket_bytes)
     futs = [manager.allreduce(buf) for buf, _ in buckets]
     for f in futs:
         f.wait()
-    out = unflatten_buckets(buckets, host)
+    averaged = unflatten_buckets(buckets, host)
+
+    out: List[Any] = []
+    it = iter(averaged)
+    for item, leaf in zip(rebuild, leaves):
+        if item[0] == "dense":
+            out.append(next(it))
+        else:
+            _, template, idxs = item
+            by_idx = {idx: next(it) for idx in idxs}
+            arrays = [
+                jax.device_put(by_idx[_index_desc(index, template.shape)], dev)
+                for dev, index in template.sharding.addressable_devices_indices_map(
+                    template.shape
+                ).items()
+            ]
+            out.append(
+                jax.make_array_from_single_device_arrays(
+                    template.shape, template.sharding, arrays
+                )
+            )
     return jax.tree_util.tree_unflatten(treedef, out)

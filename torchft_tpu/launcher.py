@@ -47,6 +47,17 @@ class _Group:
     restarts: int = 0
 
 
+def _free_port() -> int:
+    # NOTE: bind/close races another process onto the port before rank 0's
+    # jax coordinator binds it — rare, and self-healing: the group dies at
+    # startup and the supervisor loop respawns it with a fresh port.
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
 def _spawn_group(
     gid: int,
     cmd: Sequence[str],
@@ -59,6 +70,12 @@ def _spawn_group(
 
     store = StoreServer()
     group = _Group(gid=gid, store=store)
+    # multi-process group: hand out a fresh jax coordinator endpoint so the
+    # workers form one multi-controller JAX runtime (a group-wide mesh)
+    # via parallel.multihost.initialize_group. Single-host launcher →
+    # localhost; a cluster scheduler sets TORCHFT_JAX_COORDINATOR to the
+    # group's rank-0 host itself.
+    coordinator = f"localhost:{_free_port()}" if nproc > 1 else None
     for rank in range(nproc):
         env = dict(base_env)
         env.update(
@@ -69,6 +86,8 @@ def _spawn_group(
             RANK=str(rank),
             WORLD_SIZE=str(nproc),
         )
+        if coordinator is not None:
+            env["TORCHFT_JAX_COORDINATOR"] = coordinator
         group.procs.append(subprocess.Popen(list(cmd), env=env))
     return group
 

@@ -214,6 +214,7 @@ class Manager:
         self._commit_failures = 0  # pending data-plane flush request
         self._errored: Optional[Exception] = None
         self._healing = False
+        self._group_healing = False
         self._pending_work: List[Future] = []
         self._batches_committed = 0
 
@@ -258,6 +259,7 @@ class Manager:
 
         self._errored = None
         self._healing = False
+        self._group_healing = False
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -272,6 +274,9 @@ class Manager:
                 # from a good state; no zero-grad dance needed
                 self._apply_pending_state_dict()
                 self._healing = False
+            # sync quorum: every rank healed before the forward pass, so
+            # the whole group participates with real gradients
+            self._group_healing = False
 
     def wait_quorum(self) -> None:
         """Block until the in-flight quorum completes; the data plane is
@@ -303,6 +308,10 @@ class Manager:
             if self._use_async_quorum or not allow_heal
             else (quorum.replica_rank, quorum.replica_world_size)
         )
+        # plane-consistent zero-contribution gate: if ANY local rank of
+        # this group heals, every rank contributes zeros this step (see
+        # coord.cc compute_quorum_results group_heal)
+        self._group_healing = allow_heal and quorum.group_heal
 
         if self._world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
             # demote groups beyond min_replica_size to zero-contributing spares
@@ -584,7 +593,7 @@ class Manager:
         self.wait_quorum()
         if self._participating_rank is None:
             return False
-        if self._healing:
+        if self._healing or self._group_healing:
             assert self._use_async_quorum
             return False
         return True

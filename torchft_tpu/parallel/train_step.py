@@ -75,6 +75,15 @@ class TrainStep:
             return jax.jit(self.tx.init)(params)
 
     def shard_batch(self, tokens) -> jnp.ndarray:
+        if not self._batch_sharding.is_fully_addressable:
+            # multi-host group: every process holds the full batch (same
+            # sampler state); carve out each local device's shard
+            import numpy as np
+
+            arr = np.asarray(tokens)
+            return jax.make_array_from_callback(
+                arr.shape, self._batch_sharding, lambda idx: arr[idx]
+            )
         return jax.device_put(tokens, self._batch_sharding)
 
     # -- drive --
