@@ -86,6 +86,20 @@ class TestMultiRank:
         for out in outs:
             np.testing.assert_allclose(out, want)
 
+    def test_allreduce_bfloat16_ring(self, store):
+        """ml_dtypes buffers must cross the ring (gradients are bf16; plain
+        memoryview() rejects them — _bytes_view reinterprets as uint8)."""
+        import ml_dtypes
+
+        def fn(c, rank):
+            a = np.full(300, float(rank + 1), dtype=ml_dtypes.bfloat16)
+            return c.allreduce([a], ReduceOp.AVG).wait(timedelta(seconds=10))[0]
+
+        outs = _run_world(store, 2, fn, prefix="arbf16")
+        for out in outs:
+            assert out.dtype == ml_dtypes.bfloat16
+            np.testing.assert_allclose(out.astype(np.float32), 1.5)
+
     def test_allreduce_avg_and_max(self, store):
         def fn(c, rank):
             a = np.full(5, float(rank), dtype=np.float64)

@@ -61,6 +61,47 @@ def proxy_pair():
 
 
 class TestCollectivesProxy:
+    def test_allreduce_shm_path(self, proxy_pair):
+        """Buckets above the shm threshold ride shared memory (one copy
+        each way, no pickle) and still land in-place in caller buffers —
+        the reference's _maybe_share_tensors (process_group.py:775-786)."""
+        import glob
+
+        n = 1 << 16  # 256 KB of f32 — well over _SHM_MIN_BYTES
+        a = np.full(n, 1.0, dtype=np.float32)
+        b = np.full(n, 2.0, dtype=np.float32)
+        # only python shm segments (psm_*) count; other processes' /dev/shm
+        # churn (semaphores etc.) must not flake this
+        before = set(glob.glob("/dev/shm/psm_*"))
+        w0 = proxy_pair[0].allreduce([a], ReduceOp.SUM)
+        w1 = proxy_pair[1].allreduce([b], ReduceOp.SUM)
+        w0.wait(timeout=timedelta(seconds=20))
+        w1.wait(timeout=timedelta(seconds=20))
+        np.testing.assert_array_equal(a, np.full(n, 3.0, np.float32))
+        np.testing.assert_array_equal(b, np.full(n, 3.0, np.float32))
+        # segments are unlinked after copy-back (no /dev/shm leak); poll
+        # briefly in case another local test's segment is mid-flight
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            leaked = set(glob.glob("/dev/shm/psm_*")) - before
+            if not leaked:
+                break
+            time.sleep(0.05)
+        assert not leaked, leaked
+
+    def test_allreduce_shm_bfloat16(self, proxy_pair):
+        import ml_dtypes
+
+        n = 1 << 16
+        a = np.full(n, 1.0, dtype=ml_dtypes.bfloat16)
+        b = np.full(n, 2.0, dtype=ml_dtypes.bfloat16)
+        w0 = proxy_pair[0].allreduce([a], ReduceOp.AVG)
+        w1 = proxy_pair[1].allreduce([b], ReduceOp.AVG)
+        w0.wait(timeout=timedelta(seconds=20))
+        w1.wait(timeout=timedelta(seconds=20))
+        np.testing.assert_array_equal(a.astype(np.float32), 1.5)
+        np.testing.assert_array_equal(b.astype(np.float32), 1.5)
+
     def test_allreduce_in_place(self, proxy_pair):
         a = np.array([1.0, 2.0], dtype=np.float32)
         b = np.array([3.0, 4.0], dtype=np.float32)

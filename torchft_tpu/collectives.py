@@ -174,7 +174,13 @@ def _recv_frame(sock: socket.socket, expect_tag: int) -> bytearray:
 
 def _bytes_view(arr: np.ndarray) -> memoryview:
     """Byte-level view of an array (frame lengths are in bytes)."""
-    return memoryview(np.ascontiguousarray(arr)).cast("B")
+    arr = np.ascontiguousarray(arr)
+    try:
+        return memoryview(arr).cast("B")
+    except (ValueError, TypeError):
+        # ml_dtypes (bfloat16/fp8) reject the buffer protocol directly; a
+        # uint8 reinterpret view of the same memory does not
+        return memoryview(arr.view(np.uint8)).cast("B")
 
 
 def _flat_view(arr: np.ndarray) -> np.ndarray:

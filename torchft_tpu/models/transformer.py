@@ -338,9 +338,74 @@ def loss_fn(
 ) -> jnp.ndarray:
     """Next-token cross entropy; position S-1 is unsupervised (targets are
     tokens shifted left; same [B, S] shape keeps sp sharding aligned)."""
+    if max(cfg.pp, 1) > 1 and mesh is not None:
+        # pipelined training path: the head (final norm + unembed + NLL)
+        # runs inside the pipeline's manual region on the last stage and
+        # only SCALAR reductions cross the pp axis — the replicate-the-
+        # activations psum the plain forward() pays is for logits
+        # consumers, not the training loop
+        return _pipelined_loss(params, tokens, cfg, mesh)
     logits = forward(params, tokens, cfg, mesh)
     targets = jnp.roll(tokens, -1, axis=1)
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
     mask = jnp.ones_like(nll).at[:, -1].set(0.0)
     return jnp.sum(nll * mask) / jnp.sum(mask)
+
+
+def _pipelined_loss(
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    mesh,
+) -> jnp.ndarray:
+    """pp>1 loss with the cheap pipeline exit (pipeline.py head_fn): same
+    numbers as the forward()+loss composition, minus the O(activations)
+    psum that existed only to replicate the last stage's outputs."""
+    from torchft_tpu.parallel.pipeline import pipeline_forward
+
+    b, s = tokens.shape
+    dt = cfg.dtype
+    pp = cfg.pp
+    x = jnp.take(params["embed"].astype(dt), tokens, axis=0)
+    x = _constrain(x, _act_spec())
+    layers = jax.tree_util.tree_map(lambda a: a.astype(dt), params["layers"])
+
+    sp_size = mesh.shape.get("sp", 1)
+    sp_manual = sp_size > 1
+    stage_fn = _make_stage_fn(cfg, mesh, sp_manual=sp_manual)
+    m = cfg.microbatches or pp
+    assert b % m == 0, f"batch {b} must divide into {m} microbatches"
+    x_mb = x.reshape(m, b // m, s, -1)
+    # the shifted targets are built OUTSIDE the manual region so GSPMD
+    # handles the cross-sp-block halo of the roll
+    t_mb = jnp.roll(tokens, -1, axis=1).reshape(m, b // m, s)
+    head_params = {
+        "final_norm": params["final_norm"].astype(dt),
+        "out": params["out"].astype(dt),
+    }
+
+    def head_fn(hp, outs, t):
+        h = rms_norm(outs, hp["final_norm"], cfg.norm_eps)
+        logits = (h @ hp["out"]).astype(jnp.float32)
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logprobs, t[..., None], axis=-1)[..., 0]
+        mask = jnp.ones_like(nll)
+        if sp_manual:
+            # global position S-1 lives in the LAST sp block only
+            last_block = jax.lax.axis_index("sp") == sp_size - 1
+            mask = mask.at[..., -1].set(jnp.where(last_block, 0.0, 1.0))
+        else:
+            mask = mask.at[..., -1].set(0.0)
+        return {"nll": jnp.sum(nll * mask), "cnt": jnp.sum(mask)}
+
+    res = pipeline_forward(
+        layers,
+        x_mb,
+        stage_fn,
+        mesh,
+        head_fn=head_fn,
+        head_params=head_params,
+        head_extras=(t_mb,),
+    )
+    return res["nll"] / res["cnt"]

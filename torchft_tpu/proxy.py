@@ -15,10 +15,14 @@ any `Collectives` backend the same way:
 * child death surfaces as RuntimeError on the next op within ~1s — the
   Manager latches it and reconfigures at the next quorum.
 
-Payloads travel by pickle; for the cross-replica-group control volumes this
-framework routes through the proxy (gradient buckets), the copy is cheap
-relative to the network hop, and unlike the reference's shared-memory
-tensors it keeps the child fully crash-isolated.
+Large ``allreduce`` payloads (gradient buckets) travel through POSIX
+shared memory — the ``_maybe_share_tensors`` analogue
+(process_group.py:775-786): the parent stages the buffers into a per-op
+segment, the child runs the backend's in-place ring directly on the
+mapped views, and the parent copies the reduced bytes back — one copy
+each way instead of pickling megabytes through a pipe twice. Small or
+non-numpy payloads (and every cold op) stay on the pickle path, which
+keeps the child fully crash-isolated.
 """
 
 from __future__ import annotations
@@ -27,7 +31,8 @@ import logging
 import multiprocessing as mp
 import threading
 from datetime import timedelta
-from typing import Any, Callable, Dict, List, Optional
+from multiprocessing import shared_memory
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -38,6 +43,66 @@ from torchft_tpu.multiprocessing import MonitoredQueue
 logger = logging.getLogger(__name__)
 
 __all__ = ["CollectivesProxy"]
+
+# below this total, pickling through the queue beats shm setup syscalls
+_SHM_MIN_BYTES = 1 << 16
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # noqa: F401 — registers bfloat16/fp8 names
+
+        return np.dtype(name)
+
+
+def _buf_views(buf, metas: List[Tuple[int, Tuple[int, ...], str]]) -> List[np.ndarray]:
+    # go through a uint8 view: ml_dtypes (bfloat16/fp8) reject the raw
+    # buffer protocol that np.ndarray(buffer=...) uses
+    views = []
+    for off, shape, dt in metas:
+        dtype = _resolve_dtype(dt)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        views.append(
+            np.frombuffer(buf, np.uint8, count=nbytes, offset=off)
+            .view(dtype)
+            .reshape(shape)
+        )
+    return views
+
+
+def _safe_close(shm: shared_memory.SharedMemory) -> None:
+    """Close the mapping; numpy views hold buffer exports until refcounts
+    drop, so fall back to a gc pass (a still-open mapping only holds
+    virtual memory — unlink is what frees /dev/shm space, and it never
+    fails on open mappings)."""
+    try:
+        shm.close()
+    except BufferError:
+        import gc
+
+        gc.collect()
+        try:
+            shm.close()
+        except BufferError:
+            pass
+
+
+def _child_allreduce(backend: Collectives, buf, metas, op) -> None:
+    # scoped so the views (and the Work future that captures them) are
+    # dropped before the caller closes the mapping
+    backend.allreduce(_buf_views(buf, metas), op).wait()
+
+
+def _copy_out(shm, metas, arrays: List[np.ndarray]) -> None:
+    for dst, view in zip(arrays, _buf_views(shm.buf, metas)):
+        np.copyto(dst, view)
+
+
+def _copy_in(shm, metas, arrays: List[np.ndarray]) -> None:
+    for view, a in zip(_buf_views(shm.buf, metas), arrays):
+        np.copyto(view, a)
 
 
 def _worker(factory, store_addr, rank, world_size, tx, rx) -> None:
@@ -56,8 +121,36 @@ def _worker(factory, store_addr, rank, world_size, tx, rx) -> None:
             return
         op_id, name, args, kwargs = cmd
         try:
-            work = getattr(backend, name)(*args, **kwargs)
-            result = work.wait()
+            if name == "allreduce_shm":
+                shm_name, metas, op = args
+                # attach by raw mmap of the POSIX segment: SharedMemory's
+                # attach path registers with the resource tracker (CPython
+                # <=3.12 has no track=False), which would both leak a
+                # registration per op and let a dying child's tracker
+                # unlink segments the parent still owns; a plain mmap has
+                # no tracker involvement at all
+                import mmap as mmap_mod
+                import os
+
+                fd = os.open(f"/dev/shm/{shm_name}", os.O_RDWR)
+                try:
+                    buf = mmap_mod.mmap(fd, 0)
+                finally:
+                    os.close(fd)
+                try:
+                    # the backend reduces IN PLACE on the mapped views; the
+                    # reduced bytes are visible to the parent with no
+                    # return payload
+                    _child_allreduce(backend, buf, metas, op)
+                    result = None
+                finally:
+                    try:
+                        buf.close()
+                    except BufferError:
+                        pass  # views freed with the op; mapping dies with us
+            else:
+                work = getattr(backend, name)(*args, **kwargs)
+                result = work.wait()
             rx.put(("ok", op_id, result))
         except Exception as e:  # noqa: BLE001
             rx.put(("err", op_id, e))
@@ -192,7 +285,45 @@ class CollectivesProxy(Collectives):
     # -- collectives --
 
     def allreduce(self, arrays, op: ReduceOp = ReduceOp.SUM) -> Work:
+        total = sum(getattr(a, "nbytes", 0) for a in arrays)
+        if total >= _SHM_MIN_BYTES and all(
+            isinstance(a, np.ndarray) and a.flags.c_contiguous for a in arrays
+        ):
+            return self._allreduce_shm(arrays, op)
         return self._copy_back(self._submit("allreduce", arrays, op), arrays)
+
+    def _allreduce_shm(self, arrays: List[np.ndarray], op: ReduceOp) -> Work:
+        """Hot path: stage buffers in a per-op shared-memory segment; the
+        child reduces in place on the mapping, the parent copies back."""
+        total = sum(a.nbytes for a in arrays)
+        shm = shared_memory.SharedMemory(create=True, size=total)
+        metas: List[Tuple[int, Tuple[int, ...], str]] = []
+        off = 0
+        for a in arrays:
+            metas.append((off, a.shape, a.dtype.name))
+            off += a.nbytes
+        try:
+            _copy_in(shm, metas, arrays)
+        except BaseException:
+            _safe_close(shm)
+            shm.unlink()
+            raise
+
+        work = self._submit("allreduce_shm", shm.name, metas, op)
+
+        def copy_back(fut: Future):
+            try:
+                fut.value()  # surface child errors
+                _copy_out(shm, metas, arrays)
+                return arrays
+            finally:
+                try:
+                    shm.unlink()  # frees /dev/shm even with open mappings
+                except FileNotFoundError:
+                    pass
+                _safe_close(shm)
+
+        return Work(work.get_future().then(copy_back))
 
     def allgather(self, arr) -> Work:
         return self._submit("allgather", arr)
