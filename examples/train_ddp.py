@@ -38,6 +38,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from torchft_tpu.utils.platform import pin_platform_from_env
+
+pin_platform_from_env()  # make JAX_PLATFORMS authoritative (cpu-mesh runs)
 import jax
 import jax.numpy as jnp
 import optax

@@ -1,0 +1,172 @@
+"""HSDP training example — the BASELINE.md "HSDP Llama-2-7B" config shape.
+
+The flagship composition: each replica group owns a fixed inner
+``jax.sharding.Mesh`` (fsdp x tp [x sp x pp] — XLA's ICI collectives,
+compiled once), while the Manager runs the elastic replica axis across
+groups. Gradients cross it through ``allreduce_gradients`` — the
+device-path backend (CollectivesDevice) when the groups share one JAX
+runtime, host TCP (DCN) across processes. Group membership changes never
+recompile the train step; a killed group live-heals its *sharded* params
+shard-by-shard from a survivor (serialization.py "shards" transfer).
+
+Env:
+
+    TORCHFT_LIGHTHOUSE=host:port
+    REPLICA_GROUP_ID / NUM_REPLICA_GROUPS (default 2)
+    MODEL=tiny|llama2-7b           preset (default tiny; 7b needs >= 8
+                                   real chips per group)
+    DEVICES_PER_GROUP=4            carve jax.devices() per group when
+                                   groups share one runtime (else use all)
+    FSDP/TP/SP/PP                  inner mesh axis sizes (default 2/2/1/1)
+    STEPS=3  BATCH=8  SEQ=16       training shape
+    DATA_PLANE=tcp|device          cross-group backend (default tcp;
+                                   device = colocated groups, one runtime)
+
+Run 2 tiny groups on the virtual CPU mesh::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+    python -m torchft_tpu.launcher --groups 2 -- python examples/train_hsdp.py
+
+Reference parity: fsdp_test.py:40-64 (fully_shard over ft_init_device_mesh)
+re-designed TPU-first — the inner mesh is GSPMD shardings, not FSDP2.
+"""
+
+import logging
+import os
+import sys
+from datetime import timedelta
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from torchft_tpu.utils.platform import pin_platform_from_env
+
+pin_platform_from_env()  # make JAX_PLATFORMS authoritative (cpu-mesh runs)
+import jax
+import jax.numpy as jnp
+import optax
+
+from torchft_tpu.manager import Manager
+from torchft_tpu.models.transformer import TransformerConfig
+from torchft_tpu.parallel.ft import FTTrainer
+from torchft_tpu.parallel.mesh import MeshConfig, make_mesh
+from torchft_tpu.parallel.multihost import initialize_group
+from torchft_tpu.parallel.train_step import TrainStep
+from torchft_tpu.store import StoreServer
+
+logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s: %(message)s")
+logger = logging.getLogger("train_hsdp")
+
+PRESETS = {
+    # CPU-mesh testable
+    "tiny": dict(
+        vocab_size=64, d_model=16, n_layers=2, n_heads=2, head_dim=8, d_ff=32
+    ),
+    # Llama-2-7B shape (BASELINE.md north-star config); bf16, needs real
+    # chips — fsdp>=8 per group on v5e for the ~13 GB of params+optimizer
+    "llama2-7b": dict(
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        head_dim=128,
+        d_ff=11008,
+    ),
+}
+
+
+def main() -> None:
+    replica_group = int(os.environ.get("REPLICA_GROUP_ID", 0))
+    num_groups = int(os.environ.get("NUM_REPLICA_GROUPS", 2))
+    steps = int(os.environ.get("STEPS", 3))
+    batch = int(os.environ.get("BATCH", 8))
+    seq = int(os.environ.get("SEQ", 16))
+    preset = os.environ.get("MODEL", "tiny")
+
+    store_addr = os.environ.get("TORCHFT_STORE_ADDR")
+    store = None
+    if store_addr is None:
+        store = StoreServer()
+        store_addr = store.address()
+
+    initialize_group()  # multi-host group: join its jax runtime (no-op else)
+
+    mesh_cfg = MeshConfig(
+        fsdp=int(os.environ.get("FSDP", 2)),
+        tp=int(os.environ.get("TP", 2)),
+        sp=int(os.environ.get("SP", 1)),
+        pp=int(os.environ.get("PP", 1)),
+    )
+    per_group = int(os.environ.get("DEVICES_PER_GROUP", 0))
+    if per_group:
+        devices = jax.devices()[
+            replica_group * per_group : (replica_group + 1) * per_group
+        ]
+    else:
+        devices = jax.devices()
+    mesh = make_mesh(mesh_cfg, devices=devices)
+
+    dtype = jnp.float32 if preset == "tiny" else jnp.bfloat16
+    cfg = TransformerConfig(dtype=dtype, pp=mesh_cfg.pp, **PRESETS[preset])
+    ts = TrainStep(cfg, optax.adamw(3e-4), mesh)
+
+    if os.environ.get("DATA_PLANE", "tcp") == "device":
+        from torchft_tpu.collectives_device import CollectivesDevice
+
+        collectives = CollectivesDevice(timeout=timedelta(seconds=30))
+    else:
+        from torchft_tpu.collectives import CollectivesTcp
+
+        collectives = CollectivesTcp(timeout=timedelta(seconds=30))
+
+    manager = Manager(
+        collectives=collectives,
+        load_state_dict=None,  # wired by FTTrainer.init
+        state_dict=None,
+        min_replica_size=min(2, num_groups),
+        replica_id=f"hsdp_{replica_group}",
+        store_addr=store_addr,
+        rank=int(os.environ.get("RANK", 0)),
+        world_size=int(os.environ.get("WORLD_SIZE", 1)),
+        timeout=timedelta(seconds=30),
+    )
+    try:
+        trainer = FTTrainer(manager, ts)
+        trainer.init(jax.random.PRNGKey(0))
+        n_params = sum(
+            int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(trainer.params)
+        )
+        logger.info(
+            "model=%s params=%.1fM mesh=%s", preset, n_params / 1e6, mesh_cfg.sizes
+        )
+
+        data_rng = np.random.default_rng(1000 + replica_group)
+        while manager.current_step() < steps:
+            tokens = jnp.asarray(
+                data_rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32
+            )
+            loss, committed = trainer.step(tokens)
+            logger.info(
+                "step=%d committed=%s participants=%d loss=%.4f",
+                manager.current_step(),
+                committed,
+                manager.num_participants(),
+                loss,
+            )
+        checksum = sum(
+            float(jnp.sum(l.astype(jnp.float32)))
+            for l in jax.tree_util.tree_leaves(trainer.params)
+        )
+        logger.info(
+            "done: step=%d param_checksum=%.6f", manager.current_step(), checksum
+        )
+    finally:
+        manager.shutdown(wait=False)
+        if store is not None:
+            store.shutdown()
+
+
+if __name__ == "__main__":
+    main()

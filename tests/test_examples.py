@@ -1,0 +1,89 @@
+"""End-to-end example configs from BASELINE.md: "DiLoCo 4 groups" and the
+HSDP composition, driven as real subprocesses against an in-process
+lighthouse, asserting cross-group state convergence (the reference's
+integ-test bar: state-dict equality across groups)."""
+
+import os
+import re
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.store import StoreServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_groups(script: str, num_groups: int, extra_env: dict, min_replicas=None):
+    lighthouse = LighthouseServer(
+        bind="[::]:0", min_replicas=min_replicas or num_groups
+    )
+    stores = [StoreServer() for _ in range(num_groups)]
+    try:
+
+        def run(g):
+            env = dict(os.environ)
+            env.update(
+                TORCHFT_LIGHTHOUSE=lighthouse.address(),
+                TORCHFT_STORE_ADDR=stores[g].address(),
+                REPLICA_GROUP_ID=str(g),
+                NUM_REPLICA_GROUPS=str(num_groups),
+                RANK="0",
+                WORLD_SIZE="1",
+                JAX_PLATFORMS="cpu",
+            )
+            env.update(extra_env)
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "examples", script)],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=240,
+                cwd=REPO,
+            )
+            assert proc.returncode == 0, proc.stderr[-3000:]
+            return proc.stderr + proc.stdout
+
+        with ThreadPoolExecutor(max_workers=num_groups) as pool:
+            return list(pool.map(run, range(num_groups)))
+    finally:
+        for s in stores:
+            s.shutdown()
+        lighthouse.shutdown()
+
+
+def _checksums(logs, pattern=r"param_checksum=(-?\d+\.\d+)"):
+    sums = []
+    for log in logs:
+        m = re.search(pattern, log)
+        assert m, log[-2000:]
+        sums.append(m.group(1))
+    return sums
+
+
+def test_diloco_four_groups():
+    logs = _run_groups(
+        "train_diloco.py",
+        num_groups=4,
+        extra_env={"OUTER_STEPS": "2", "SYNC_EVERY": "2"},
+    )
+    sums = _checksums(logs)
+    # outer steps averaged pseudogradients across all 4 groups: identical
+    # outer state everywhere (bit-identical, reference integ-test bar)
+    assert len(set(sums)) == 1, sums
+
+
+def test_hsdp_example_two_groups():
+    logs = _run_groups(
+        "train_hsdp.py",
+        num_groups=2,
+        extra_env={
+            "STEPS": "3",
+            "DEVICES_PER_GROUP": "4",
+            "FSDP": "2",
+            "TP": "2",
+        },
+    )
+    sums = _checksums(logs)
+    assert len(set(sums)) == 1, sums

@@ -73,6 +73,19 @@ def local_sgd_train_loop(
             )
         wrapper.save(holder["params"])
 
+        # live recovery must carry the wrapper's backup/outer state along
+        # with the raw params, or a rejoiner syncs from a stale snapshot
+        def load_state_full(sd):
+            load_state(sd)
+            wrapper.load_state_dict(sd["wrapper"])
+
+        def save_state_full():
+            sd = save_state()
+            sd["wrapper"] = wrapper.state_dict()
+            return sd
+
+        manager.set_state_dict_fns(load_state_full, save_state_full)
+
         data_rng = np.random.default_rng(2000 + runner.replica_id * 31 + rank)
         while manager.current_step() < total_syncs:
             x = data_rng.standard_normal((8, 3)).astype(np.float32)
@@ -82,6 +95,7 @@ def local_sgd_train_loop(
                 holder["params"], holder["opt_state"], grads
             )
             holder["params"] = wrapper.step(holder["params"])
+            runner.failure_injector.check(rank, manager.current_step())
 
         out = {
             "params": jax.tree_util.tree_map(np.asarray, holder["params"]),
@@ -165,8 +179,21 @@ def test_local_sgd_backup_does_not_alias_live_params():
 
 @pytest.mark.parametrize("mode", ["local_sgd", "diloco"])
 def test_local_sgd_modes(mode):
+    _run_modes(mode, [FailureInjector(), FailureInjector()])
+
+
+@pytest.mark.parametrize("mode", ["local_sgd", "diloco"])
+def test_local_sgd_modes_recovery(mode):
+    """Kill group 0 after its first committed sync: the restart heals the
+    wrapper's backup (and DiLoCo outer state) from the survivor, and its
+    stale local params are replaced by the received backup at the next
+    sync (LocalSGD._just_healed) — final states must still be identical
+    (the reference's local_sgd_integ recovery bar)."""
+    _run_modes(mode, [FailureInjector().fail_at(0, 1), FailureInjector()])
+
+
+def _run_modes(mode, injectors):
     lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
-    injectors = [FailureInjector(), FailureInjector()]
     try:
         with ThreadPoolExecutor(max_workers=2) as ex:
             futs = [

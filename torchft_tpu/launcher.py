@@ -133,6 +133,7 @@ def launch(
         for g in range(num_groups)
     ]
     exit_code = 0
+    finished_clean = 0
     try:
         while groups:
             time.sleep(0.5)
@@ -142,12 +143,24 @@ def launch(
                     logger.info("group %d finished clean", group.gid)
                     _teardown_group(group)
                     groups.remove(group)
+                    finished_clean += 1
                 elif any(c is not None and c != 0 for c in codes):
                     logger.warning(
                         "group %d worker died (codes %s)", group.gid, codes
                     )
                     _teardown_group(group)
                     groups.remove(group)
+                    if finished_clean >= num_groups - 1 and num_groups > 1:
+                        # every peer already finished clean: a respawn can
+                        # never re-quorum (min_replicas unreachable) and
+                        # would hang until max_restarts — the cohort's work
+                        # is complete, so count this group done too
+                        logger.info(
+                            "group %d died after all peers finished; job "
+                            "complete, not respawning",
+                            group.gid,
+                        )
+                        continue
                     if group.restarts < max_restarts:
                         fresh = _spawn_group(
                             group.gid, cmd, num_groups, nproc,

@@ -47,6 +47,7 @@ class LocalSGD:
         self._sync_every = sync_every
         self._local_step = 0
         self._backup: Optional[Any] = None
+        self._just_healed = False
 
     def save(self, params: Any) -> None:
         """Snapshot ``params`` to host as the restore point. ``copy=True``
@@ -68,7 +69,36 @@ class LocalSGD:
 
     def sync(self, params: Any) -> Any:
         self._manager.start_quorum()
-        return self._perform_sync(params)
+        # Functional-JAX heal gap the reference never has: torch heals
+        # mutate the model in place, so the caller's reference aliases the
+        # healed tensors — here `params` was captured BEFORE start_quorum
+        # ran the (sync-mode) heal. A just-healed group's only consistent
+        # state is the received backup: syncing from it contributes a zero
+        # pseudogradient (DiLoCo) / the healed params (LocalSGD), exactly
+        # what a replica with no inner progress since the backup should.
+        if self._just_healed:
+            params = _to_host(self._backup, copy=True)
+        try:
+            return self._perform_sync(params)
+        finally:
+            # also covers async-quorum heals that land inside
+            # _perform_sync's commit barrier: the received backup is
+            # reconciled there (backup := committed average), so the flag
+            # must never leak into the next sync and discard real work
+            self._just_healed = False
+
+    # live-recovery snapshot (wire into Manager.set_state_dict_fns along
+    # with the caller's params/inner state; the reference leaves this to
+    # the integ harness — here it's part of the wrapper)
+    def state_dict(self) -> dict:
+        return {"backup": self._backup, "local_step": self._local_step}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._backup = _to_host(state["backup"], copy=True)
+        self._local_step = int(state["local_step"])
+        # the caller's local params are stale relative to this received
+        # state; the next sync must start from the backup (see sync())
+        self._just_healed = True
 
     def _perform_sync(self, params: Any) -> Any:
         # allreduce_gradients averages any pytree — here, the params
@@ -129,3 +159,12 @@ class DiLoCo(LocalSGD):
 
     def outer_state(self) -> Any:
         return self._outer_state
+
+    def state_dict(self) -> dict:
+        d = super().state_dict()
+        d["outer_state"] = self._outer_state
+        return d
+
+    def load_state_dict(self, state: dict) -> None:
+        super().load_state_dict(state)
+        self._outer_state = state["outer_state"]

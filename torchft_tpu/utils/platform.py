@@ -1,0 +1,22 @@
+"""Backend-selection helper shared by the example trainers and workers."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["pin_platform_from_env"]
+
+
+def pin_platform_from_env() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative.
+
+    Some environments register an accelerator PJRT plugin from
+    sitecustomize that wins over the env var; setting the config key
+    explicitly restores the documented env contract (e.g.
+    ``JAX_PLATFORMS=cpu`` for the virtual CPU mesh in tests/launch
+    recipes). Call before any other jax API touches the backend."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
