@@ -62,7 +62,14 @@ def _model_flops_per_step(cfg, n_params: int, batch: int, seq: int) -> float:
 
 def train_bench(cfg, batch, seq, steps, warmup, averaging: bool):
     """Measured FT train loop; returns steps/s."""
+    import gc
+
     import jax
+
+    # drop the previous variant's params/executables before allocating —
+    # compiled programs pin device buffers and variants don't share shapes
+    gc.collect()
+    jax.clear_caches()
     import jax.numpy as jnp
     import optax
 
@@ -188,6 +195,28 @@ def main() -> None:
             "tokens_per_sec": round(lc_sps * lc_batch * lc_seq),
             "mfu_pct": round(lc_sps * lc_flops / peak * 100.0, 2) if peak else None,
             "attention": "xla fused (pallas flash auto-engages at s>=8192)",
+        }
+
+    # scale variant (TPU only): the d512 headline model is small enough to
+    # be dispatch/attention-bound; at 647M params the same FT loop shows
+    # the compute ceiling (~45% MFU on v5e)
+    if on_tpu:
+        big = TransformerConfig(
+            vocab_size=32000,
+            d_model=2048,
+            n_layers=12,
+            n_heads=16,
+            head_dim=64,
+            d_ff=5632,
+            dtype=jnp.bfloat16,
+        )
+        big_sps, big_n = train_bench(big, 4, 1024, 8, 2, averaging=True)
+        big_flops = _model_flops_per_step(big, big_n, 4, 1024)
+        extra["scale_647M"] = {
+            "steps_per_sec": round(big_sps, 4),
+            "tokens_per_sec": round(big_sps * 4 * 1024),
+            "n_params": big_n,
+            "mfu_pct": round(big_sps * big_flops / peak * 100.0, 2) if peak else None,
         }
 
     # recovery envelope (BASELINE.md driver metric): 2 replica groups in

@@ -13,9 +13,13 @@ Env (same launcher contract as train_ddp.py):
     OUTER_STEPS=4                  outer (sync) steps to run
     SYNC_EVERY=8                   inner steps between syncs
 
-Run 4 groups under the launcher::
+Run 4 groups under the launcher (``--min-replicas 2`` mirrors the
+Manager's ``min_replica_size`` so survivors keep committing while a
+killed group is down — the launcher's default lighthouse would otherwise
+require all 4 to participate)::
 
-    python -m torchft_tpu.launcher --groups 4 -- python examples/train_diloco.py
+    python -m torchft_tpu.launcher --groups 4 --min-replicas 2 -- \\
+        python examples/train_diloco.py
 
 Kill any group mid-run: the survivors' next sync commits without it (down
 to min_replica_size), and a restarted group rejoins at the next quorum —

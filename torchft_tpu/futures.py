@@ -129,7 +129,9 @@ class _TimeoutManager:
 
     def __init__(self) -> None:
         self._cond = threading.Condition()
-        self._heap: List[Tuple[float, int, Future[Any]]] = []
+        # heap entries hold a one-element SLOT, cleared when the future
+        # completes, so payloads are never pinned for the full deadline
+        self._heap: List[Tuple[float, int, List[Optional[Future[Any]]]]] = []
         self._seq = 0
         self._thread: Optional[threading.Thread] = None
 
@@ -137,9 +139,14 @@ class _TimeoutManager:
         import time
 
         deadline = time.monotonic() + timeout.total_seconds()
+        # the heap entry must not pin the future (and its payload — e.g. a
+        # whole gradient pytree on device) for the full deadline after it
+        # completes: clear the slot on completion, the timer skips it
+        slot: List[Optional[Future[Any]]] = [fut]
+        fut.then(lambda _f: slot.__setitem__(0, None))
         with self._cond:
             self._seq += 1
-            heapq.heappush(self._heap, (deadline, self._seq, fut))
+            heapq.heappush(self._heap, (deadline, self._seq, slot))
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._run, name="tft_timeout_manager", daemon=True
@@ -154,15 +161,16 @@ class _TimeoutManager:
             with self._cond:
                 while not self._heap:
                     self._cond.wait()
-                deadline, _, fut = self._heap[0]
+                deadline, _, slot = self._heap[0]
                 now = time.monotonic()
                 if deadline > now:
                     self._cond.wait(timeout=deadline - now)
                     continue
                 heapq.heappop(self._heap)
-            if not fut.done():
+            fut = slot[0]
+            if fut is not None and not fut.done():
                 fut.set_exception(
-                    TimeoutError(f"future did not complete within deadline")
+                    TimeoutError("future did not complete within deadline")
                 )
 
 

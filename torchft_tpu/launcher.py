@@ -133,7 +133,7 @@ def launch(
         for g in range(num_groups)
     ]
     exit_code = 0
-    finished_clean = 0
+    min_needed = min_replicas or num_groups
     try:
         while groups:
             time.sleep(0.5)
@@ -143,22 +143,29 @@ def launch(
                     logger.info("group %d finished clean", group.gid)
                     _teardown_group(group)
                     groups.remove(group)
-                    finished_clean += 1
                 elif any(c is not None and c != 0 for c in codes):
                     logger.warning(
                         "group %d worker died (codes %s)", group.gid, codes
                     )
                     _teardown_group(group)
                     groups.remove(group)
-                    if finished_clean >= num_groups - 1 and num_groups > 1:
-                        # every peer already finished clean: a respawn can
-                        # never re-quorum (min_replicas unreachable) and
-                        # would hang until max_restarts — the cohort's work
-                        # is complete, so count this group done too
+                    if lighthouse is not None and len(groups) + 1 < min_needed:
+                        # this launcher owns the quorum and a respawn plus
+                        # every still-running group cannot reach
+                        # min_replicas (the peers finished and left): the
+                        # respawn could never re-quorum and would hang to
+                        # max_restarts. The peers could only finish with
+                        # this group in their quorums, so the cohort's
+                        # work is complete. (With an external lighthouse,
+                        # other launchers' groups may keep the quorum
+                        # alive — always respawn then.)
                         logger.info(
-                            "group %d died after all peers finished; job "
+                            "group %d died with too few peers left to ever "
+                            "re-quorum (%d alive < min_replicas %d); job "
                             "complete, not respawning",
                             group.gid,
+                            len(groups) + 1,
+                            min_needed,
                         )
                         continue
                     if group.restarts < max_restarts:
