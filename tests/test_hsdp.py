@@ -7,6 +7,7 @@ the elastic replica axis through the Manager. Includes a kill/heal pass for
 sharded state (live checkpoint of sharded params).
 """
 
+import dataclasses
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
 from typing import Any, Dict
@@ -36,6 +37,18 @@ CFG = TransformerConfig(
     dtype=jnp.float32,
 )
 
+# inner-mesh variants: the parallelism x FT matrix. Each replica group owns
+# a disjoint 4-device mesh of the given shape; "moe" exercises expert
+# parallelism (top-2 capacity dispatch over ep), "sp" exercises ring
+# attention across the sequence axis — both under live sharded heal.
+VARIANTS: Dict[str, Any] = {
+    "dp_tp": (MeshConfig(dp=2, tp=2), CFG),
+    # 4 experts (2 per ep shard) keeps top-2 selection and capacity drops
+    # load-bearing — with n_experts=2 every token would hit both experts
+    "moe_ep": (MeshConfig(ep=2, tp=2), dataclasses.replace(CFG, n_experts=4)),
+    "sp_ring": (MeshConfig(sp=2, tp=2), CFG),
+}
+
 
 def hsdp_train_loop(
     rank: int,
@@ -43,10 +56,12 @@ def hsdp_train_loop(
     runner: Runner,
     total_steps: int = 3,
     backend: str = "tcp",
+    variant: str = "dp_tp",
 ) -> Dict[str, Any]:
     devices = jax.devices()[runner.replica_id * 4 : (runner.replica_id + 1) * 4]
-    mesh = make_mesh(MeshConfig(dp=2, tp=2), devices=devices)
-    ts = TrainStep(CFG, optax.sgd(0.05), mesh)
+    mesh_cfg, cfg = VARIANTS[variant]
+    mesh = make_mesh(mesh_cfg, devices=devices)
+    ts = TrainStep(cfg, optax.sgd(0.05), mesh)
 
     if backend == "device":
         from torchft_tpu.collectives_device import CollectivesDevice
@@ -74,7 +89,7 @@ def hsdp_train_loop(
         data_rng = np.random.default_rng(3000 + runner.replica_id * 13)
         while manager.current_step() < total_steps:
             tokens = jnp.asarray(
-                data_rng.integers(0, CFG.vocab_size, (4, 16)), jnp.int32
+                data_rng.integers(0, cfg.vocab_size, (4, 16)), jnp.int32
             )
             trainer.step(tokens)
             runner.failure_injector.check(rank, manager.current_step())
@@ -87,7 +102,7 @@ def hsdp_train_loop(
         manager.shutdown(wait=False)
 
 
-def _run(injectors, backend: str = "tcp"):
+def _run(injectors, backend: str = "tcp", variant: str = "dp_tp"):
     import functools
 
     lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
@@ -100,7 +115,7 @@ def _run(injectors, backend: str = "tcp"):
                         lighthouse_address=lighthouse.address(),
                         failure_injector=inj,
                         train_loop=functools.partial(
-                            hsdp_train_loop, backend=backend
+                            hsdp_train_loop, backend=backend, variant=variant
                         ),
                     ).run_replica
                 )
@@ -134,5 +149,17 @@ def test_hsdp_recovery_sharded_heal(backend):
     """Killed group heals its *sharded* params from the survivor."""
     results = _run(
         [FailureInjector(), FailureInjector().fail_at(0, 2)], backend=backend
+    )
+    assert_equal_params(results)
+
+
+@pytest.mark.parametrize("variant", ["moe_ep", "sp_ring"])
+def test_recovery_other_inner_meshes(variant):
+    """The parallelism x FT matrix: expert-parallel MoE and ring-attention
+    (sequence-parallel) inner meshes also kill/heal to bit-identical
+    state — intra-group parallelism the reference doesn't have, under the
+    reference's recovery bar."""
+    results = _run(
+        [FailureInjector(), FailureInjector().fail_at(0, 2)], variant=variant
     )
     assert_equal_params(results)
