@@ -106,15 +106,6 @@ def main() -> None:
     from torchft_tpu.parallel.multihost import initialize_group
 
     initialize_group()
-    # ONE checkpoint per group, written by rank 0 and loaded by every rank:
-    # ranks are replicated here, and a shared file + atomic os.replace means
-    # all ranks of a restarted group resume from the same step no matter
-    # when the kill landed (per-rank files could tear mid-save and silently
-    # diverge the group's rank planes)
-    ckpt_path = None
-    if ckpt_dir:
-        os.makedirs(ckpt_dir, exist_ok=True)
-        ckpt_path = os.path.join(ckpt_dir, f"group{replica_group}.ckpt")
 
     manager = Manager(
         collectives=CollectivesTcp(timeout=timedelta(seconds=30)),
@@ -140,37 +131,30 @@ def main() -> None:
     )
     value_and_grad = jax.jit(jax.value_and_grad(loss_fn))
 
-    # resume from the periodic disk checkpoint if one exists (total-failure
-    # recovery; live quorum healing covers partial failures). Loading
-    # BEFORE the first quorum makes the group report its true step, so a
-    # resumed group that is behind the cohort heals forward, never back.
-    if ckpt_path and os.path.exists(ckpt_path):
-        from torchft_tpu.checkpointing.serialization import load_state
+    # periodic disk checkpoints (total-failure recovery; live quorum
+    # healing covers partial failures): one writer per group, every rank
+    # restores from the shared snapshot, restore happens BEFORE the first
+    # quorum so a resumed group reports its true step and heals forward
+    ckpt = None
+    if ckpt_dir:
+        from torchft_tpu.checkpointing.disk import DiskCheckpointer
 
-        with open(ckpt_path, "rb") as f:
-            ckpt = load_state(f)
-        manager.load_state_dict(ckpt["torchft"])
-        opt.load_state_dict(ckpt["user"])
-        sampler.load_state_dict(ckpt["sampler"])
-        logger.info("resumed from %s at step %d", ckpt_path, manager.current_step())
-
-    last_saved_step = manager.current_step()
-
-    def save_checkpoint() -> None:
-        from torchft_tpu.checkpointing.serialization import save_state
-
-        tmp = ckpt_path + ".tmp"
-        with open(tmp, "wb") as f:
-            save_state(
-                {
-                    "torchft": manager.state_dict(),
-                    "user": opt.state_dict(),
-                    "sampler": sampler.state_dict(),
-                },
-                f,
-            )
-        os.replace(tmp, ckpt_path)  # atomic: a crash mid-write keeps the old one
-        logger.info("checkpointed step %d to %s", manager.current_step(), ckpt_path)
+        ckpt = DiskCheckpointer(
+            ckpt_dir,
+            manager,
+            state_dict=lambda: {
+                "opt": opt.state_dict(),
+                "sampler": sampler.state_dict(),
+            },
+            load_state_dict=lambda s: (
+                opt.load_state_dict(s["opt"]),
+                sampler.load_state_dict(s["sampler"]),
+            ),
+            every=ckpt_every,
+            tag=f"group{replica_group}",
+            is_writer=(rank == 0),
+        )
+        ckpt.restore()
 
     try:
         while manager.current_step() < steps:
@@ -187,14 +171,8 @@ def main() -> None:
                 manager.num_participants(),
                 float(loss),
             )
-            if (
-                ckpt_path
-                and rank == 0  # one writer per group; all ranks resume from it
-                and manager.current_step() % ckpt_every == 0
-                and manager.current_step() > last_saved_step  # only on progress
-            ):
-                save_checkpoint()
-                last_saved_step = manager.current_step()
+            if ckpt is not None:
+                ckpt.maybe_save()
         final = jax.tree_util.tree_map(lambda a: np.asarray(a).sum(), opt.params)
         logger.info("done: step=%d param_checksum=%.6f",
                     manager.current_step(),

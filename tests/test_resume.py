@@ -63,7 +63,7 @@ def test_disk_checkpoint_resume_bit_identical(tmp_path):
         first_log = _run_trainer(addr, steps=3, ckpt_dir=tmp_path)
         step3, _ = _checksum(first_log)
         assert step3 == "3"
-        assert (tmp_path / "group0.ckpt").exists()
+        assert (tmp_path / "group0_step3.ckpt").exists()
 
         # a fresh process resumes from disk and continues to step 6
         resumed_log = _run_trainer(addr, steps=6, ckpt_dir=tmp_path)

@@ -8,13 +8,19 @@ arbitrary leaves) streamed as raw host buffers:
   ``/checkpoint/{step}/full`` (or metadata + parallel chunks).
 * :class:`CollectivesTransport` — rides the reconfigurable data plane's
   send/recv (the PGTransport analogue).
+* :class:`DiskCheckpointer` — the user-owned *periodic* checkpoint the
+  reference documents but leaves to the application (manager.py:83-85):
+  step-tagged atomic snapshots with retention + restore-latest.
 """
 
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.checkpointing.collectives_transport import CollectivesTransport
+from torchft_tpu.checkpointing.disk import DiskCheckpointer
 from torchft_tpu.checkpointing.http_transport import HTTPTransport
 from torchft_tpu.checkpointing.serialization import (
+    ShardedArray,
     flatten_state,
+    from_transfer_tree,
     load_state,
     save_state,
     unflatten_state,
@@ -25,9 +31,12 @@ __all__ = [
     "CheckpointTransport",
     "HTTPTransport",
     "CollectivesTransport",
+    "DiskCheckpointer",
     "RWLock",
+    "ShardedArray",
     "flatten_state",
     "unflatten_state",
+    "from_transfer_tree",
     "save_state",
     "load_state",
 ]
