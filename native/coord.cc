@@ -442,6 +442,22 @@ static std::string html_escape(const std::string& s) {
   return out;
 }
 
+static std::string prom_escape(const std::string& s) {
+  // Prometheus exposition label-value escaping: \ " and newline
+  std::string out;
+  for (char c : s) {
+    if (c == '\\')
+      out += "\\\\";
+    else if (c == '"')
+      out += "\\\"";
+    else if (c == '\n')
+      out += "\\n";
+    else
+      out.push_back(c);
+  }
+  return out;
+}
+
 static std::string http_ok(const std::string& body,
                            const std::string& ctype = "text/html") {
   std::ostringstream o;
@@ -506,6 +522,37 @@ std::string Lighthouse::handle_http(const std::string& method,
         "t();setInterval(t,1000);</script></body></html>");
   }
   if (method == "GET" && path == "/status") return http_ok(status_html());
+  if (method == "GET" && path == "/metrics") {
+    // Prometheus text exposition — observability the reference lacks
+    // (SURVEY §5.5: "No metrics export"). Scrape-friendly names under a
+    // single torchft_ prefix.
+    std::unique_lock<std::mutex> lk(mu_);
+    int64_t now = now_ms();
+    std::ostringstream o;
+    o << "# TYPE torchft_quorum_id counter\n"
+      << "torchft_quorum_id " << state_.quorum_id << "\n"
+      << "# TYPE torchft_participants gauge\n"
+      << "torchft_participants "
+      << (state_.prev_quorum ? (int64_t)state_.prev_quorum->participants.size()
+                             : 0)
+      << "\n"
+      << "# TYPE torchft_heartbeating_replicas gauge\n"
+      << "torchft_heartbeating_replicas " << state_.heartbeats.size() << "\n";
+    if (state_.prev_quorum) {
+      o << "# TYPE torchft_quorum_age_seconds gauge\n"
+        << "torchft_quorum_age_seconds "
+        << (wall_ms() - state_.prev_quorum->created_unix_ms) / 1000.0 << "\n"
+        << "# TYPE torchft_member_step gauge\n";
+      for (const auto& p : state_.prev_quorum->participants)
+        o << "torchft_member_step{replica_id=\""
+          << prom_escape(p.replica_id) << "\"} " << p.step << "\n";
+    }
+    o << "# TYPE torchft_heartbeat_age_seconds gauge\n";
+    for (const auto& [id, beat] : state_.heartbeats)
+      o << "torchft_heartbeat_age_seconds{replica_id=\"" << prom_escape(id)
+        << "\"} " << (now - beat) / 1000.0 << "\n";
+    return http_ok(o.str(), "text/plain; version=0.0.4");
+  }
   if (method == "GET" && path == "/status.json") {
     std::unique_lock<std::mutex> lk(mu_);
     std::ostringstream o;

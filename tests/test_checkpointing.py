@@ -374,3 +374,42 @@ def test_disk_checkpointer_sharded_leaves(tmp_path):
     assert ck.restore()
     np.testing.assert_array_equal(np.asarray(holder["w"]), np.asarray(w))
     assert holder["w"].sharding.spec == P(None, "tp")
+
+
+def test_disk_checkpointer_async_save_tear_free(tmp_path):
+    """async_save: the snapshot is captured at maybe_save() time — numpy
+    leaves mutated immediately afterward must not leak into the file."""
+    import os
+
+    from torchft_tpu.checkpointing.disk import DiskCheckpointer
+
+    mgr = _ManagerStub()
+    state = {"w": np.full(1 << 16, 1.0, dtype=np.float32)}
+    ck = DiskCheckpointer(
+        str(tmp_path),
+        mgr,
+        state_dict=lambda: dict(state),
+        load_state_dict=lambda s: state.update(s),
+        every=1,
+        tag="g0",
+        async_save=True,
+    )
+    mgr.step = 1
+    path = ck.maybe_save()
+    assert path is not None
+    state["w"][...] = 999.0  # in-place mutation racing the writer
+    ck.flush()
+    assert os.path.exists(path)
+
+    mgr2 = _ManagerStub()
+    got = {}
+    ck2 = DiskCheckpointer(
+        str(tmp_path),
+        mgr2,
+        state_dict=dict,
+        load_state_dict=lambda s: got.update(s),
+        tag="g0",
+    )
+    assert ck2.restore()
+    np.testing.assert_array_equal(got["w"], 1.0)  # snapshot-time value
+    assert mgr2.step == 1

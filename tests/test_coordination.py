@@ -325,6 +325,13 @@ class TestLighthouseE2E:
                 assert resp.status == 200
             with urllib.request.urlopen(addr + "/status.json", timeout=5) as resp:
                 assert b"quorum_id" in resp.read()
+            # Prometheus exposition (beyond the reference: SURVEY §5.5
+            # notes it has no metrics export)
+            with urllib.request.urlopen(addr + "/metrics", timeout=5) as resp:
+                metrics = resp.read().decode()
+            assert "torchft_quorum_id" in metrics
+            assert "torchft_participants 1" in metrics
+            assert 'torchft_member_step{replica_id="dash_replica"} 0' in metrics
             c.close()
         finally:
             lh.shutdown()

@@ -25,6 +25,8 @@ from __future__ import annotations
 import logging
 import os
 import re
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Tuple
 
 from torchft_tpu.checkpointing.serialization import load_state, save_state
@@ -47,6 +49,7 @@ class DiskCheckpointer:
         keep: int = 3,
         tag: str = "group0",
         is_writer: bool = True,
+        async_save: bool = False,
     ) -> None:
         """
         Args:
@@ -60,6 +63,13 @@ class DiskCheckpointer:
             keep: newest checkpoints retained (older ones pruned)
             tag: filename prefix — one distinct tag per replica group
             is_writer: exactly one rank per group writes; all ranks read
+            async_save: serialize + write on a background thread so the
+                train loop never blocks on disk. The state is captured
+                synchronously — ``jax.Array`` leaves are immutable (free
+                to share with the writer), mutable numpy leaves are
+                copied — so later training steps can't tear the snapshot.
+                At most one save is in flight; a cadence hit while one is
+                running is skipped (the next hit retries).
         """
         self._dir = directory
         self._manager = manager
@@ -69,6 +79,10 @@ class DiskCheckpointer:
         self._keep = max(1, keep)
         self._tag = tag
         self._is_writer = is_writer
+        self._async = async_save
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._inflight: Optional[Future] = None
+        self._io_lock = threading.Lock()  # serializes writes with prune
         os.makedirs(directory, exist_ok=True)
         # progress gate: never snapshot the step we started at (a pristine
         # step-0 checkpoint on a fresh start is pure noise)
@@ -77,19 +91,21 @@ class DiskCheckpointer:
 
     def _cleanup_stale(self) -> None:
         for name in os.listdir(self._dir):
-            if not name.startswith(self._tag):
-                continue
             if name.endswith(".ckpt.tmp"):
-                # a writer died mid-save; the partial file is garbage
-                try:
-                    os.remove(os.path.join(self._dir, name))
-                except OSError:
-                    pass
-            elif name.endswith(".ckpt") and not _NAME.match(name):
+                # a writer died mid-save; the partial file is garbage.
+                # Exact-tag match only ("group1" must not touch
+                # "group10_step5.ckpt.tmp" in a shared directory).
+                m = _NAME.match(name[: -len(".tmp")])
+                if m and m.group("tag") == self._tag:
+                    try:
+                        os.remove(os.path.join(self._dir, name))
+                    except OSError:
+                        pass
+            elif name == f"{self._tag}.ckpt":
+                # pre-DiskCheckpointer layout (unstepped single file)
                 logger.warning(
-                    "ignoring unrecognized checkpoint %s (expected "
-                    "'%s_step<N>.ckpt' — older layout? it will NOT be "
-                    "restored)",
+                    "ignoring old-layout checkpoint %s (expected "
+                    "'%s_step<N>.ckpt'); it will NOT be restored",
                     name,
                     self._tag,
                 )
@@ -117,34 +133,88 @@ class DiskCheckpointer:
 
     # -- save --
 
-    def save(self) -> str:
-        """Write a snapshot for the current committed step (atomic: a
-        crash mid-write leaves the previous checkpoints intact)."""
-        step = self._manager.current_step()
+    def _snapshot(self) -> Any:
+        """Capture the state tear-free: jax.Arrays are immutable (shared
+        with the writer thread for free); mutable numpy leaves are copied
+        so in-place training updates can't corrupt an in-flight save."""
+        import numpy as np
+
+        state = {"torchft": self._manager.state_dict(), "user": self._state_dict()}
+        if not self._async:
+            return state
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda l: l.copy() if isinstance(l, np.ndarray) else l, state
+        )
+
+    def _write(self, step: int, state: Any) -> str:
         path = self._path(step)
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            save_state(
-                {"torchft": self._manager.state_dict(), "user": self._state_dict()},
-                f,
-            )
-        os.replace(tmp, path)
-        self._last_saved = step
+        with self._io_lock:
+            with open(tmp, "wb") as f:
+                save_state(state, f)
+            os.replace(tmp, path)
+            self._prune()
         logger.info("checkpointed step %d to %s", step, path)
-        self._prune()
         return path
+
+    def save(self) -> str:
+        """Write a snapshot for the current committed step (atomic: a
+        crash mid-write leaves the previous checkpoints intact). Blocks
+        until the bytes are on disk regardless of ``async_save``."""
+        step = self._manager.current_step()
+        self._last_saved = step
+        return self._write(step, self._snapshot())
 
     def maybe_save(self) -> Optional[str]:
         """Call once per loop iteration after ``should_commit``; saves at
-        the configured cadence, only on progress, only on the writer."""
+        the configured cadence, only on progress, only on the writer.
+        With ``async_save`` the write happens in the background and the
+        eventual path is returned immediately."""
         step = self._manager.current_step()
-        if (
+        if not (
             self._is_writer
             and step % self._every == 0
             and step > self._last_saved
         ):
+            return None
+        if not self._async:
             return self.save()
-        return None
+        if self._inflight is not None and not self._inflight.done():
+            logger.warning(
+                "skipping checkpoint at step %d: previous save still "
+                "writing (cadence faster than disk)",
+                step,
+            )
+            return None
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tft_ckpt_disk"
+            )
+        self._last_saved = step
+        state = self._snapshot()  # captured NOW, written later
+        fut = self._executor.submit(self._write, step, state)
+
+        def on_done(f: Future) -> None:
+            exc = f.exception()
+            if exc is not None:
+                # surface the failure even if nobody calls flush(), and
+                # let the next cadence hit retry this step
+                logger.error("async checkpoint of step %d failed: %s", step, exc)
+                if self._last_saved == step:
+                    self._last_saved = step - 1
+
+        fut.add_done_callback(on_done)
+        self._inflight = fut
+        return self._path(step)
+
+    def flush(self) -> None:
+        """Block until any in-flight async save has landed (call before
+        shutdown; a pending write surfaces its error here)."""
+        if self._inflight is not None:
+            self._inflight.result()
+            self._inflight = None
 
     def _prune(self) -> None:
         for _, path in self._existing()[: -self._keep]:
