@@ -42,7 +42,6 @@ from torchft_tpu.utils.platform import pin_platform_from_env
 
 pin_platform_from_env()  # make JAX_PLATFORMS authoritative (cpu-mesh runs)
 import jax
-import jax.numpy as jnp
 import optax
 
 from torchft_tpu.collectives import CollectivesTcp
@@ -156,7 +155,10 @@ def main() -> None:
         )
         ckpt.restore()
 
+    import time
+
     try:
+        prev_step = manager.current_step()
         while manager.current_step() < steps:
             sampler.set_epoch(manager.current_step())
             idx = np.fromiter(iter(sampler), dtype=np.int64)[:batch]
@@ -164,6 +166,11 @@ def main() -> None:
             opt.begin_step()  # async quorum overlaps the forward pass
             loss, grads = value_and_grad(opt.params, x[idx], y[idx])
             opt.step(grads)
+            if manager.current_step() == prev_step:
+                # failed commit (e.g. waiting for enough replicas): back
+                # off instead of hammering the quorum in a busy loop
+                time.sleep(0.2)
+            prev_step = manager.current_step()
             logger.info(
                 "step=%d batches_committed=%d participants=%d loss=%.4f",
                 manager.current_step(),

@@ -142,12 +142,16 @@ def main() -> None:
             "model=%s params=%.1fM mesh=%s", preset, n_params / 1e6, mesh_cfg.sizes
         )
 
+        import time
+
         data_rng = np.random.default_rng(1000 + replica_group)
         while manager.current_step() < steps:
             tokens = jnp.asarray(
                 data_rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32
             )
             loss, committed = trainer.step(tokens)
+            if not committed:
+                time.sleep(0.2)  # back off while the quorum is short
             logger.info(
                 "step=%d committed=%s participants=%d loss=%.4f",
                 manager.current_step(),

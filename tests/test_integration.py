@@ -224,6 +224,55 @@ def test_ddp_recovery(use_async_quorum):
     assert injectors[1].count == 1
 
 
+def test_fixed_with_spares_promotion():
+    """WorldSizeMode.FIXED_WITH_SPARES, 3 groups, min_replica_size=2: the
+    third group is a hot spare contributing zeros; when a primary dies
+    permanently (no restart — the rejoin path is covered by
+    test_ddp_recovery) the spare promotes into its slot and training
+    continues with the SAME divisor/effective batch size
+    (manager.py:55-70 semantics, integration-tested here)."""
+    from torchft_tpu.manager import WorldSizeMode
+
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+    injectors = [
+        FailureInjector(),
+        FailureInjector().fail_at(0, 2),
+        FailureInjector(),
+    ]
+    try:
+        with ThreadPoolExecutor(max_workers=3) as executor:
+            futures = [
+                executor.submit(
+                    Runner(
+                        replica_id=i,
+                        lighthouse_address=lighthouse.address(),
+                        failure_injector=inj,
+                        train_loop=ddp_train_loop,
+                        # the dying group stays dead: promotion must carry
+                        # the job without it
+                        attempts=1,
+                        manager_args={
+                            "world_size_mode": WorldSizeMode.FIXED_WITH_SPARES,
+                        },
+                    ).run_replica
+                )
+                for i, inj in enumerate(injectors)
+            ]
+            survivors = [futures[0].result(timeout=120)]
+            with pytest.raises(InjectedFailure):
+                futures[1].result(timeout=120)
+            survivors.append(futures[2].result(timeout=120))
+    finally:
+        lighthouse.shutdown()
+    # primary 0 and the promoted spare finished in lockstep
+    ref = survivors[0][0]
+    other = survivors[1][0]
+    assert ref["step"] >= 4 and other["step"] >= 4
+    for key in ref["params"]:
+        np.testing.assert_array_equal(ref["params"][key], other["params"][key])
+    assert injectors[1].count == 1
+
+
 def test_ddp_recovery_multi_rank():
     lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
     # both ranks of the group die together (a half-dead group can only be

@@ -129,10 +129,15 @@ class _StubManager:
         return None
 
     def allreduce(self, arr):
+        # match the real Manager.allreduce: unwrap to the single array
+        return self.allreduce_many([arr]).then(lambda f: f.value()[0])
+
+    def allreduce_many(self, arrays):
         from torchft_tpu.futures import Future
 
-        np.divide(arr, self.num_participants(), out=arr)
-        return Future.completed(arr)
+        for arr in arrays:
+            np.divide(arr, self.num_participants(), out=arr)
+        return Future.completed(arrays)
 
     def should_commit(self):
         return self._commits.pop(0)

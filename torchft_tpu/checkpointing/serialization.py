@@ -134,7 +134,6 @@ def _index_desc(index: Tuple, shape: Tuple[int, ...]) -> Tuple:
 def _sharding_desc(arr) -> Any:
     """``(axis_names, mesh_shape, spec_entries)`` for a NamedSharding-ed
     jax.Array spanning >1 device, else None (dense path)."""
-    import jax
     from jax.sharding import NamedSharding
 
     s = getattr(arr, "sharding", None)

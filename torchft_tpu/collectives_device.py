@@ -40,7 +40,7 @@ from __future__ import annotations
 import threading
 from collections import deque
 from datetime import timedelta
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 

@@ -142,9 +142,9 @@ def allreduce_gradients(
             host.append(np.ascontiguousarray(np.asarray(leaf)))
 
     buckets = flatten_buckets(host, bucket_bytes)
-    futs = [manager.allreduce(buf) for buf, _ in buckets]
-    for f in futs:
-        f.wait()
+    # one managed op for all buckets (in-place on the numpy buffers):
+    # same bytes, a single SPMD slot instead of per-bucket dispatch
+    manager.allreduce_many([buf for buf, _ in buckets]).wait()
     averaged = unflatten_buckets(buckets, host)
 
     out: List[Any] = []

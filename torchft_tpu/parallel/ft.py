@@ -21,8 +21,6 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple
 
-import numpy as np
-
 from torchft_tpu.ddp import allreduce_gradients
 from torchft_tpu.manager import Manager
 from torchft_tpu.parallel.train_step import TrainStep

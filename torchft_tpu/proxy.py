@@ -32,10 +32,11 @@ import multiprocessing as mp
 import threading
 from datetime import timedelta
 from multiprocessing import shared_memory
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from torchft_tpu.checkpointing.serialization import _resolve_dtype
 from torchft_tpu.collectives import Collectives, ReduceOp, Work
 from torchft_tpu.futures import Future
 from torchft_tpu.multiprocessing import MonitoredQueue
@@ -46,15 +47,6 @@ __all__ = ["CollectivesProxy"]
 
 # below this total, pickling through the queue beats shm setup syscalls
 _SHM_MIN_BYTES = 1 << 16
-
-
-def _resolve_dtype(name: str) -> np.dtype:
-    try:
-        return np.dtype(name)
-    except TypeError:
-        import ml_dtypes  # noqa: F401 — registers bfloat16/fp8 names
-
-        return np.dtype(name)
 
 
 def _buf_views(buf, metas: List[Tuple[int, Tuple[int, ...], str]]) -> List[np.ndarray]:
