@@ -7,6 +7,7 @@ cross-group state convergence (the BASELINE.md v5e-32 north-star shape:
 replica groups that span hosts)."""
 
 import os
+import re
 import subprocess
 import sys
 
@@ -15,6 +16,98 @@ from torchft_tpu.launcher import _free_port
 from torchft_tpu.store import StoreServer
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_multihost_group_kill_respawn_heal(tmp_path):
+    """The north-star scenario (BASELINE.md): replica groups spanning
+    processes, one group SIGKILLed mid-run. The launcher tears down and
+    respawns the whole group (fresh store + fresh jax coordinator — a
+    multi-controller runtime cannot lose a member and live, so groups
+    fail as units, exactly like torchrun+torchelastic in the reference);
+    the respawned pair re-forms its mesh, rejoins the quorum, and heals
+    its SHARDED state per rank from the survivor. All four processes must
+    end bit-identical."""
+    import signal
+    import time
+
+    wrapper = tmp_path / "wrap.sh"
+    wrapper.write_text(
+        "#!/bin/bash\n"
+        f"cd {REPO}\n"
+        "exec python examples/train_hsdp.py >> "
+        f"{tmp_path}/g${{REPLICA_GROUP_ID}}_r${{RANK}}.$$.log 2>&1\n"
+    )
+    wrapper.chmod(0o755)
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        STEPS="12",
+        FSDP="2",
+        TP="2",
+        BATCH="8",
+        SEQ="16",
+    )
+    launcher = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "torchft_tpu.launcher",
+            "--groups",
+            "2",
+            "--nproc",
+            "2",
+            "--",
+            str(wrapper),
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        # wait for group 1 to reach step 4, then SIGKILL that exact worker
+        # (its pid is embedded in the log filename — no pkill guessing)
+        deadline = time.monotonic() + 240
+        victim = None
+        while time.monotonic() < deadline:
+            for p in tmp_path.glob("g1_r0.*.log"):
+                if "step=4 " in p.read_text():
+                    victim = p
+                    break
+            if victim is not None:
+                break
+            assert launcher.poll() is None, "launcher died early"
+            time.sleep(0.5)
+        else:
+            raise TimeoutError("group 1 never reached step 4")
+        if "done:" in victim.read_text():
+            import pytest
+
+            pytest.skip("run finished before the kill could land mid-flight")
+        pid = int(victim.name.split(".")[1])
+        os.kill(pid, signal.SIGKILL)
+        assert launcher.wait(timeout=240) == 0
+    finally:
+        if launcher.poll() is None:
+            launcher.send_signal(signal.SIGINT)
+            try:
+                launcher.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                launcher.kill()
+                launcher.wait(timeout=30)
+
+    sums = []
+    healed = 0
+    for p in sorted(tmp_path.glob("g*_r*.log")):
+        text = p.read_text()
+        healed += text.count("healing: fetching checkpoint metadata")
+        m = re.findall(r"param_checksum=(-?\d+\.\d+)", text)
+        if m:
+            sums.append(m[-1])
+    assert len(sums) == 4, sums  # both original g0 procs + respawned g1 pair
+    assert len(set(sums)) == 1, sums  # bit-identical across hosts and groups
+    assert healed >= 1  # the respawned group actually live-healed
 
 
 def test_two_groups_of_two_processes(tmp_path):
