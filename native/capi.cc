@@ -60,7 +60,8 @@ void tft_buf_free(uint8_t* p) { free(p); }
 // ---- lighthouse ----
 int64_t tft_lighthouse_create(const char* bind, uint64_t min_replicas,
                               uint64_t join_timeout_ms, uint64_t quorum_tick_ms,
-                              uint64_t heartbeat_timeout_ms, char* err,
+                              uint64_t heartbeat_timeout_ms,
+                              uint64_t evict_probe_ms, char* err,
                               int errlen) {
   try {
     LighthouseOpt opt;
@@ -68,6 +69,7 @@ int64_t tft_lighthouse_create(const char* bind, uint64_t min_replicas,
     opt.join_timeout_ms = join_timeout_ms;
     opt.quorum_tick_ms = quorum_tick_ms;
     opt.heartbeat_timeout_ms = heartbeat_timeout_ms;
+    opt.evict_probe_ms = evict_probe_ms;
     auto lh = std::make_unique<Lighthouse>(bind, opt);
     std::lock_guard<std::mutex> g(g_mu);
     int64_t h = g_next++;

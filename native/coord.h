@@ -74,6 +74,9 @@ struct ManagerQuorumResult {
                            // contributes zeros (participation gate must be
                            // rank-plane-consistent; extension beyond the
                            // reference's per-rank flag, manager.py:268-269)
+  // Quorum members' replica_ids in replica_rank order, so the data plane can
+  // map a failed peer's ring rank back to a replica_id for lh.evict reports.
+  std::vector<std::string> participant_ids;
 
   Value to_value() const;
 };
@@ -85,6 +88,13 @@ struct LighthouseOpt {
   uint64_t join_timeout_ms = 60000;
   uint64_t quorum_tick_ms = 100;
   uint64_t heartbeat_timeout_ms = 5000;
+  // Survivor-reported eviction (lh.evict): before expiring an accused
+  // replica's heartbeat, the lighthouse actively probes its manager address
+  // with this connect timeout. Probe success = report ignored, so a false
+  // report about a live peer is a no-op; probe failure = immediate expiry,
+  // beating the passive heartbeat-lease floor (src/lighthouse.rs:119-128
+  // has only the passive path).
+  uint64_t evict_probe_ms = 100;
 };
 
 struct MemberDetails {
@@ -132,6 +142,7 @@ class Lighthouse {
   Value handle_rpc(const std::string& method, const Value& req,
                    int64_t deadline);
   Value handle_quorum(const Value& req, int64_t deadline);
+  Value handle_evict(const Value& req);
   std::string handle_http(const std::string& method, const std::string& path);
   void tick_loop();
   // Must hold mu_. Runs one quorum evaluation and publishes if met.

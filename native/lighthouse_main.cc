@@ -13,7 +13,7 @@ static void usage() {
   fprintf(stderr,
           "usage: tft_lighthouse --min_replicas N [--bind [::]:29510]\n"
           "  [--join_timeout_ms 60000] [--quorum_tick_ms 100]\n"
-          "  [--heartbeat_timeout_ms 5000]\n");
+          "  [--heartbeat_timeout_ms 5000] [--evict_probe_ms 100]\n");
   exit(2);
 }
 
@@ -41,6 +41,8 @@ int main(int argc, char** argv) {
     else if (!strcmp(argv[i], "--heartbeat_timeout_ms"))
       opt.heartbeat_timeout_ms =
           strtoull(need("--heartbeat_timeout_ms"), nullptr, 10);
+    else if (!strcmp(argv[i], "--evict_probe_ms"))
+      opt.evict_probe_ms = strtoull(need("--evict_probe_ms"), nullptr, 10);
     else
       usage();
   }

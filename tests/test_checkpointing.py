@@ -413,3 +413,105 @@ def test_disk_checkpointer_async_save_tear_free(tmp_path):
     assert ck2.restore()
     np.testing.assert_array_equal(got["w"], 1.0)  # snapshot-time value
     assert mgr2.step == 1
+
+
+def test_disk_checkpointer_per_process_merge(tmp_path):
+    """Multi-host sharded checkpoints (round-2 advisor finding): one writer
+    per group cannot serialize a cross-process-sharded leaf, so every
+    process writes a ``procIofN`` shard file and restore() merges the set.
+    Two simulated hosts each hold half the shards of an ('x',)-sharded
+    (8,4) leaf; restore must pool them so the full array is recoverable."""
+    from torchft_tpu.checkpointing.disk import DiskCheckpointer, _NAME
+    from torchft_tpu.checkpointing.serialization import ShardedArray, save_state
+
+    full = np.arange(32, dtype=np.float32).reshape(8, 4)
+    mesh_desc = (("x",), (4,))
+    spec = ("x",)
+
+    def half(lo_rows):
+        shards = [
+            (((r, r + 2), (0, 4)), full[r : r + 2]) for r in lo_rows
+        ]
+        return ShardedArray(np.dtype(np.float32), (8, 4), mesh_desc, spec, shards)
+
+    # hand-write the two per-process files (the write path on a real
+    # multi-host deployment produces exactly this layout via _target_path)
+    for pidx, rows in ((0, (0, 2)), (1, (4, 6))):
+        torchft = {"step": 5, "batches_committed": 10}
+        path = tmp_path / f"g0_step5.proc{pidx}of2.ckpt"
+        with open(path, "wb") as f:
+            save_state({"torchft": torchft, "user": {"w": half(rows)}}, f)
+        assert _NAME.match(path.name)
+
+    mgr = _ManagerStub()
+    got = {}
+    ck = DiskCheckpointer(
+        str(tmp_path),
+        mgr,
+        state_dict=dict,
+        load_state_dict=lambda s: got.update(s),
+        tag="g0",
+    )
+    assert ck.restore() is True
+    assert mgr.step == 5
+    merged = got["w"]
+    assert isinstance(merged, ShardedArray)
+    assert len(merged.shards) == 4  # both halves pooled
+    np.testing.assert_array_equal(merged.full(), full)
+
+
+def test_disk_checkpointer_incomplete_proc_set_not_restorable(tmp_path):
+    """A per-process set missing a writer (host died mid-save) must not be
+    offered as restorable — restore falls back to an older complete step."""
+    from torchft_tpu.checkpointing.disk import DiskCheckpointer
+    from torchft_tpu.checkpointing.serialization import save_state
+
+    # complete dense checkpoint at step 3
+    with open(tmp_path / "g0_step3.ckpt", "wb") as f:
+        save_state(
+            {
+                "torchft": {"step": 3, "batches_committed": 6},
+                "user": {"w": np.ones(2, np.float32)},
+            },
+            f,
+        )
+    # step 5: only proc0of2 present — incomplete
+    with open(tmp_path / "g0_step5.proc0of2.ckpt", "wb") as f:
+        save_state(
+            {
+                "torchft": {"step": 5, "batches_committed": 10},
+                "user": {"w": np.zeros(2, np.float32)},
+            },
+            f,
+        )
+    mgr = _ManagerStub()
+    got = {}
+    ck = DiskCheckpointer(
+        str(tmp_path),
+        mgr,
+        state_dict=dict,
+        load_state_dict=lambda s: got.update(s),
+        tag="g0",
+    )
+    assert ck.restore() is True
+    assert mgr.step == 3  # fell back to the complete step
+    np.testing.assert_array_equal(got["w"], 1.0)
+
+
+def test_disk_checkpointer_needs_per_process_detection():
+    """Single-process (even with an 8-device mesh) state is fully
+    addressable — the dense single-writer layout stays in effect."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchft_tpu.checkpointing.disk import _needs_per_process
+
+    devs = jax.devices("cpu")[:4]
+    mesh = Mesh(np.array(devs), ("x",))
+    arr = jax.device_put(
+        jnp.arange(8, dtype=jnp.float32), NamedSharding(mesh, P("x"))
+    )
+    assert arr.is_fully_addressable
+    assert _needs_per_process({"w": arr}) is False
+    assert _needs_per_process({"w": np.ones(3)}) is False

@@ -259,22 +259,39 @@ class TestComputeQuorumResults:
             _native.compute_quorum_results(q, "zz", 0)
 
     def test_group_heal_is_plane_consistent(self):
-        """Participation gating must agree across a group's rank planes:
-        with 2-rank groups at the step-0 striped bootstrap, EVERY group has
-        a healing rank somewhere, so every (group, rank) reports
-        group_heal — otherwise plane 0 would average real gradients while
-        plane 1 averages zeros and replicated/sharded state diverges
-        (extension beyond the reference's per-rank gate, manager.py:268)."""
+        """Participation gating must agree across a group's rank planes —
+        otherwise plane 0 would average real gradients while plane 1
+        averages zeros and replicated/sharded state diverges (extension
+        beyond the reference's per-rank gate, manager.py:268). At the
+        step-0 bootstrap every group heals from ONE source (the cohort's
+        first) rather than the rank-striped primary: striping would make
+        every group heal somewhere, zeroing every contribution and turning
+        the first committed step into a pure weight-decay update (round-2
+        advisor finding)."""
         q = quorum(
             1, [member("a", 0, world_size=2), member("b", 0, world_size=2)]
         )
-        for rid in ("a", "b"):
-            for rank in (0, 1):
-                r = _native.compute_quorum_results(q, rid, rank)
-                assert r["group_heal"] is True, (rid, rank)
-        # per-rank heal still stripes (it drives WHO fetches state)
-        assert _native.compute_quorum_results(q, "a", 0)["heal"] is False
-        assert _native.compute_quorum_results(q, "a", 1)["heal"] is True
+        # bootstrap source group: no plane heals, contributes real grads
+        for rank in (0, 1):
+            ra = _native.compute_quorum_results(q, "a", rank)
+            assert ra["group_heal"] is False, rank
+            assert ra["heal"] is False, rank
+            assert ra["recover_dst_ranks"] == [1], rank
+        # every other group heals on EVERY plane, from the same source
+        for rank in (0, 1):
+            rb = _native.compute_quorum_results(q, "b", rank)
+            assert rb["group_heal"] is True, rank
+            assert rb["heal"] is True, rank
+            assert rb["recover_src_rank"] == 0, rank
+        # store striping is untouched by the bootstrap rule
+        assert _native.compute_quorum_results(q, "a", 0)["store_address"] == "store_a"
+        assert _native.compute_quorum_results(q, "a", 1)["store_address"] == "store_b"
+
+    def test_participant_ids_in_rank_order(self):
+        q = quorum(7, [member("z", 5), member("a", 5), member("m", 3)])
+        r = _native.compute_quorum_results(q, "a", 0)
+        ids = [s if isinstance(s, str) else s.decode() for s in r["participant_ids"]]
+        assert ids == ["a", "m", "z"]
 
     def test_group_heal_matches_heal_for_single_rank_groups(self):
         q0 = quorum(1, [member("a", 0), member("b", 0)])
@@ -518,3 +535,139 @@ class TestManagerE2E:
                 world_size=1,
                 connect_timeout=timedelta(milliseconds=200),
             )
+
+
+class TestEviction:
+    """Survivor-reported eviction (lh.evict): active dead-peer detection
+    that beats the passive heartbeat-lease floor the reference shares
+    (src/lighthouse.rs:119-128 only ages out leases)."""
+
+    def _quorum_pair(self, lh, mgrs):
+        """Drive both managers through one quorum so prev_quorum exists."""
+        results = {}
+
+        def run(i):
+            c = ManagerClient(mgrs[i].address(), connect_timeout=timedelta(seconds=10))
+            results[i] = c._quorum(
+                rank=0, step=1, checkpoint_metadata="",
+                shrink_only=False, timeout=timedelta(seconds=10),
+            )
+            c.close()
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(len(mgrs))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return results
+
+    def test_false_report_does_not_evict_live_peer(self):
+        lh = LighthouseServer(bind="[::]:0", min_replicas=2, join_timeout_ms=100)
+        mgrs = [
+            ManagerServer(
+                replica_id=f"rep_{i}", lighthouse_addr=lh.address(),
+                hostname="localhost", bind="[::]:0", store_addr=f"s{i}",
+                world_size=1,
+            )
+            for i in range(2)
+        ]
+        try:
+            self._quorum_pair(lh, mgrs)
+            c = LighthouseClient(lh.address(), connect_timeout=timedelta(seconds=5))
+            # rep_1 is alive and listening — the probe succeeds, report is
+            # a no-op
+            assert c.evict("rep_0", "rep_1") is False
+            # the next quorum still contains both members
+            res = self._quorum_pair(lh, mgrs)
+            assert res[0].replica_world_size == 2
+            assert sorted(res[0].participant_ids) == ["rep_0", "rep_1"]
+            c.close()
+        finally:
+            for m in mgrs:
+                m.shutdown()
+            lh.shutdown()
+
+    def test_dead_peer_evicted_without_lease_wait(self):
+        # long heartbeat lease: only eviction (not expiry) can explain a
+        # fast quorum without the victim
+        lh = LighthouseServer(
+            bind="[::]:0", min_replicas=1, join_timeout_ms=60000,
+            heartbeat_timeout_ms=60000,
+        )
+        mgrs = [
+            ManagerServer(
+                replica_id=f"rep_{i}", lighthouse_addr=lh.address(),
+                hostname="localhost", bind="[::]:0", store_addr=f"s{i}",
+                world_size=1,
+            )
+            for i in range(2)
+        ]
+        try:
+            self._quorum_pair(lh, mgrs)
+            mgrs[1].shutdown()  # SIGKILL stand-in: socket gone, no goodbyes
+            c = LighthouseClient(lh.address(), connect_timeout=timedelta(seconds=5))
+            t0 = time.monotonic()
+            assert c.evict("rep_0", "rep_1") is True
+            # survivor re-quorums immediately — no 60s lease, no join wait
+            mc = ManagerClient(mgrs[0].address(), connect_timeout=timedelta(seconds=10))
+            r = mc._quorum(
+                rank=0, step=2, checkpoint_metadata="",
+                shrink_only=False, timeout=timedelta(seconds=10),
+            )
+            assert time.monotonic() - t0 < 2.0
+            assert r.replica_world_size == 1
+            assert r.participant_ids == ["rep_0"]
+            mc.close()
+            c.close()
+        finally:
+            for m in mgrs:
+                m.shutdown()
+            lh.shutdown()
+
+    def test_evict_guards(self):
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1, join_timeout_ms=100)
+        mgr = ManagerServer(
+            replica_id="rep_0", lighthouse_addr=lh.address(),
+            hostname="localhost", bind="[::]:0", store_addr="s0",
+            world_size=1,
+        )
+        try:
+            c = LighthouseClient(lh.address(), connect_timeout=timedelta(seconds=5))
+            # no quorum yet
+            with pytest.raises(RuntimeError):
+                c.evict("rep_0", "rep_1")
+            self._quorum_pair(lh, [mgr])
+            # reporter not a member
+            with pytest.raises(RuntimeError):
+                c.evict("stranger", "rep_0")
+            # victim not a member
+            with pytest.raises(RuntimeError):
+                c.evict("rep_0", "stranger")
+            # self-report
+            with pytest.raises(RuntimeError):
+                c.evict("rep_0", "rep_0")
+            c.close()
+        finally:
+            mgr.shutdown()
+            lh.shutdown()
+
+    def test_manager_forwards_evict(self):
+        lh = LighthouseServer(bind="[::]:0", min_replicas=2, join_timeout_ms=100)
+        mgrs = [
+            ManagerServer(
+                replica_id=f"rep_{i}", lighthouse_addr=lh.address(),
+                hostname="localhost", bind="[::]:0", store_addr=f"s{i}",
+                world_size=1,
+            )
+            for i in range(2)
+        ]
+        try:
+            self._quorum_pair(lh, mgrs)
+            mgrs[1].shutdown()
+            mc = ManagerClient(mgrs[0].address(), connect_timeout=timedelta(seconds=10))
+            assert mc.evict("rep_1") is True
+            mc.close()
+        finally:
+            for m in mgrs:
+                m.shutdown()
+            lh.shutdown()

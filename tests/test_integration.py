@@ -286,6 +286,82 @@ def test_ddp_recovery_multi_rank():
     assert injectors[1].count == 2
 
 
+def test_store_epoch_gc_soak():
+    """Hundreds of data-plane flush re-quorums must not grow the store:
+    every epoch writes coll/addr keys under torchft/{quorum_id}/ and the
+    round-2 review found nothing ever deleted them (weak #5). Rank 0 now
+    sweeps stale epochs on every reconfigure; after the soak, at most the
+    current and previous epochs' keys may remain on any store."""
+    from torchft_tpu.store import StoreClient
+
+    lighthouse = LighthouseServer(
+        bind="[::]:0", min_replicas=2, join_timeout_ms=100
+    )
+    stores = [StoreServer(), StoreServer()]
+    rounds = 150
+    errors: List[BaseException] = []
+
+    def loop(gid: int) -> None:
+        manager = Manager(
+            collectives=CollectivesTcp(timeout=timedelta(seconds=10)),
+            load_state_dict=lambda s: None,
+            state_dict=lambda: {"x": 1},
+            min_replica_size=2,
+            replica_id=f"g{gid}",
+            store_addr=stores[gid].address(),
+            rank=0,
+            world_size=1,
+            lighthouse_addr=lighthouse.address(),
+            timeout=timedelta(seconds=10),
+            quorum_timeout=timedelta(seconds=30),
+        )
+        try:
+            for _ in range(rounds):
+                manager.start_quorum()
+                manager.wait_quorum()
+                if manager.current_step() == 0:
+                    # clean bootstrap first: committing once completes the
+                    # step-0 heal, so the flush rounds below never need the
+                    # checkpoint path again (both groups stay at equal step)
+                    manager.allreduce(np.ones(4, np.float32)).wait()
+                    manager.should_commit()
+                    continue
+                # force a data-plane flush: the latched error fails the
+                # commit, and the next quorum bumps quorum_id for everyone
+                manager.report_error(RuntimeError("forced flush"))
+                assert manager.should_commit() is False
+        except BaseException as e:  # noqa: BLE001 — surface on main thread
+            errors.append(e)
+            raise
+        finally:
+            manager.shutdown(wait=False)
+
+    try:
+        threads = [
+            threading.Thread(target=loop, args=(gid,)) for gid in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "soak worker wedged"
+        assert not errors, errors
+        for store in stores:
+            client = StoreClient(store.address())
+            keys = [
+                k if isinstance(k, str) else k.decode()
+                for k in client.keys("torchft/")
+            ]
+            epochs = {int(k.split("/")[1]) for k in keys}
+            assert len(epochs) <= 2, f"stale epochs leaked: {sorted(epochs)}"
+            assert len(keys) <= 8, f"store keys leaked: {len(keys)}"
+            client.close()
+    finally:
+        lighthouse.shutdown()
+        for store in stores:
+            store.shutdown()
+
+
 def test_quorum_timeout():
     """start_quorum with a tiny deadline on an unformable quorum returns a
     TimeoutError quickly (manager_integ_test.py:325-368 analogue)."""

@@ -64,7 +64,7 @@ def _load() -> ctypes.CDLL:
     lib.tft_buf_free.restype = None
 
     lib.tft_lighthouse_create.argtypes = [
-        c.c_char_p, c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint64,
+        c.c_char_p, c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint64,
         c.c_char_p, c.c_int,
     ]
     lib.tft_lighthouse_create.restype = c.c_int64
@@ -190,11 +190,12 @@ def lighthouse_create(
     join_timeout_ms: int,
     quorum_tick_ms: int,
     heartbeat_timeout_ms: int,
+    evict_probe_ms: int = 100,
 ) -> Tuple[int, str]:
     err = _errbuf()
     h = _lib.tft_lighthouse_create(
         bind.encode(), min_replicas, join_timeout_ms, quorum_tick_ms,
-        heartbeat_timeout_ms, err, _ERRLEN,
+        heartbeat_timeout_ms, evict_probe_ms, err, _ERRLEN,
     )
     if h == 0:
         raise RuntimeError(err.value.decode())

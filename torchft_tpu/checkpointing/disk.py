@@ -15,9 +15,16 @@ covers *total* failures (every group lost) and planned restarts. Load
 happens BEFORE the first quorum so a resumed group reports its true step
 and heals forward, never backward.
 
-Multi-rank groups: exactly one writer per group (rank 0 by convention —
-pass ``is_writer=False`` elsewhere); every rank restores from the shared
-file so the group's rank planes can never resume at different steps.
+Multi-rank groups: for fully-addressable state, exactly one writer per
+group (rank 0 by convention — pass ``is_writer=False`` elsewhere) and
+every rank restores from the shared file, so the group's rank planes can
+never resume at different steps. When the state holds
+**non-fully-addressable** ``jax.Array`` leaves (a cross-process-sharded
+multi-host group), a single writer can only serialize its own
+addressable shards — so in that case EVERY process writes its own
+``..procIofN.ckpt`` shard file (the ``is_writer`` convention then applies
+per process, not per group) and :meth:`restore` merges all N files'
+shards before handing the tree back (round-2 advisor finding).
 """
 
 from __future__ import annotations
@@ -29,13 +36,83 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, List, Optional, Tuple
 
-from torchft_tpu.checkpointing.serialization import load_state, save_state
+from torchft_tpu.checkpointing.serialization import (
+    ShardedArray,
+    load_state,
+    save_state,
+)
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["DiskCheckpointer"]
 
-_NAME = re.compile(r"^(?P<tag>.+)_step(?P<step>\d+)\.ckpt$")
+_NAME = re.compile(
+    r"^(?P<tag>.+)_step(?P<step>\d+)(?:\.proc(?P<pidx>\d+)of(?P<pcount>\d+))?\.ckpt$"
+)
+
+
+def _needs_per_process(state: Any) -> bool:
+    """True when any leaf is a jax.Array whose shards span processes: one
+    writer's ``addressable_shards`` would then be an incomplete checkpoint
+    (round-2 advisor finding on the single-writer convention)."""
+    try:
+        import jax
+    except Exception:
+        return False
+    for leaf in jax.tree_util.tree_leaves(state):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return True
+    return False
+
+
+def _merge_shard_trees(trees: List[Any]) -> Any:
+    """Merge per-process checkpoint trees: :class:`ShardedArray` leaves
+    pool their shards (deduplicated by index), every other leaf is taken
+    from the first tree (they are replicated across writers)."""
+    from torchft_tpu.checkpointing.serialization import _tree_util
+
+    tu = _tree_util()
+    is_sharded = lambda l: isinstance(l, ShardedArray)  # noqa: E731
+    flat = [tu.tree_flatten(t, is_leaf=is_sharded) for t in trees]
+    leaves0, treedef = flat[0]
+    for _, other_def in flat[1:]:
+        if other_def != treedef:
+            # never silently pool shards across mismatched structures (a
+            # partial code rollout renaming a key would pair shards with
+            # the wrong parameter)
+            raise ValueError(
+                "per-process checkpoints disagree on tree structure: "
+                f"{other_def} != {treedef}"
+            )
+    merged: List[Any] = []
+    for i, leaf in enumerate(leaves0):
+        if not isinstance(leaf, ShardedArray):
+            merged.append(leaf)
+            continue
+        seen = {}
+        for leaves, _ in flat:
+            other = leaves[i]
+            if (
+                not isinstance(other, ShardedArray)
+                or other.shape != leaf.shape
+                or other.dtype != leaf.dtype
+            ):
+                raise ValueError(
+                    "per-process checkpoints disagree on leaf "
+                    f"{leaf.shape}/{leaf.dtype}"
+                )
+            for idx, data in other.shards:
+                seen.setdefault(idx, data)
+        merged.append(
+            ShardedArray(
+                leaf.dtype,
+                leaf.shape,
+                leaf.mesh_desc,
+                leaf.spec_entries,
+                list(seen.items()),
+            )
+        )
+    return tu.tree_unflatten(treedef, merged)
 
 
 class DiskCheckpointer:
@@ -62,7 +139,11 @@ class DiskCheckpointer:
             every: save cadence in committed steps
             keep: newest checkpoints retained (older ones pruned)
             tag: filename prefix — one distinct tag per replica group
-            is_writer: exactly one rank per group writes; all ranks read
+            is_writer: for fully-addressable state, exactly one rank per
+                group writes and all ranks read. For cross-process-sharded
+                state the convention is per *process*: set True on one
+                rank of every process (each writes its own
+                ``..procIofN.ckpt`` shard file; restore merges the set)
             async_save: serialize + write on a background thread so the
                 train loop never blocks on disk. The state is captured
                 synchronously — ``jax.Array`` leaves are immutable (free
@@ -115,21 +196,51 @@ class DiskCheckpointer:
     def _path(self, step: int) -> str:
         return os.path.join(self._dir, f"{self._tag}_step{step}.ckpt")
 
-    def _existing(self) -> List[Tuple[int, str]]:
-        out = []
+    def _proc_path(self, step: int, pidx: int, pcount: int) -> str:
+        return os.path.join(
+            self._dir, f"{self._tag}_step{step}.proc{pidx}of{pcount}.ckpt"
+        )
+
+    def _existing(self) -> List[Tuple[int, List[str]]]:
+        """``[(step, [paths])]`` sorted by step, only *complete* steps: a
+        dense checkpoint is one file; a per-process checkpoint counts only
+        when all N ``procIofN`` files are present (a host that died
+        mid-save must not offer a half checkpoint as restorable)."""
+        dense: dict = {}
+        procs: dict = {}
         try:
             names = os.listdir(self._dir)
         except FileNotFoundError:
-            return out
+            return []
         for name in names:
             m = _NAME.match(name)
-            if m and m.group("tag") == self._tag:
-                out.append((int(m.group("step")), os.path.join(self._dir, name)))
+            if not m or m.group("tag") != self._tag:
+                continue
+            step = int(m.group("step"))
+            path = os.path.join(self._dir, name)
+            if m.group("pidx") is None:
+                dense[step] = path
+            else:
+                procs.setdefault(step, {})[int(m.group("pidx"))] = (
+                    path,
+                    int(m.group("pcount")),
+                )
+        out: List[Tuple[int, List[str]]] = [
+            (step, [path]) for step, path in dense.items()
+        ]
+        for step, by_idx in procs.items():
+            counts = {pcount for _, pcount in by_idx.values()}
+            if len(counts) == 1 and len(by_idx) == next(iter(counts)):
+                out.append(
+                    (step, [by_idx[i][0] for i in sorted(by_idx)])
+                )
         return sorted(out)
 
     def latest(self) -> Optional[str]:
+        """Path of the newest complete checkpoint (first file of a
+        per-process set)."""
         existing = self._existing()
-        return existing[-1][1] if existing else None
+        return existing[-1][1][0] if existing else None
 
     # -- save --
 
@@ -148,8 +259,17 @@ class DiskCheckpointer:
             lambda l: l.copy() if isinstance(l, np.ndarray) else l, state
         )
 
-    def _write(self, step: int, state: Any) -> str:
-        path = self._path(step)
+    def _target_path(self, step: int, state: Any) -> str:
+        """Dense single-writer file, or this process's shard file when the
+        state is sharded across processes (one writer cannot serialize
+        remote shards — round-2 advisor finding)."""
+        if _needs_per_process(state):
+            import jax
+
+            return self._proc_path(step, jax.process_index(), jax.process_count())
+        return self._path(step)
+
+    def _write(self, step: int, state: Any, path: str) -> str:
         tmp = path + ".tmp"
         with self._io_lock:
             with open(tmp, "wb") as f:
@@ -165,7 +285,8 @@ class DiskCheckpointer:
         until the bytes are on disk regardless of ``async_save``."""
         step = self._manager.current_step()
         self._last_saved = step
-        return self._write(step, self._snapshot())
+        state = self._snapshot()
+        return self._write(step, state, self._target_path(step, state))
 
     def maybe_save(self) -> Optional[str]:
         """Call once per loop iteration after ``should_commit``; saves at
@@ -194,7 +315,8 @@ class DiskCheckpointer:
             )
         self._last_saved = step
         state = self._snapshot()  # captured NOW, written later
-        fut = self._executor.submit(self._write, step, state)
+        path = self._target_path(step, state)
+        fut = self._executor.submit(self._write, step, state, path)
 
         def on_done(f: Future) -> None:
             exc = f.exception()
@@ -207,7 +329,7 @@ class DiskCheckpointer:
 
         fut.add_done_callback(on_done)
         self._inflight = fut
-        return self._path(step)
+        return path
 
     def flush(self) -> None:
         """Block until any in-flight async save has landed (call before
@@ -217,27 +339,64 @@ class DiskCheckpointer:
             self._inflight = None
 
     def _prune(self) -> None:
-        for _, path in self._existing()[: -self._keep]:
-            try:
-                os.remove(path)
-            except OSError:
-                pass
+        existing = self._existing()
+        for _, paths in existing[: -self._keep]:
+            for path in paths:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+        # Orphan sweep: incomplete per-process sets (a host died mid-save,
+        # or an elastic resize changed process_count) are invisible to
+        # _existing() and would otherwise leak forever. Anything older than
+        # the oldest *retained complete* step is dead; newer incomplete
+        # sets are left alone (a peer may still be mid-write).
+        kept = existing[-self._keep :]
+        if not kept:
+            return
+        floor = kept[0][0]
+        keep_paths = {p for _, paths in kept for p in paths}
+        try:
+            names = os.listdir(self._dir)
+        except FileNotFoundError:
+            return
+        for name in names:
+            m = _NAME.match(name)
+            if not m or m.group("tag") != self._tag:
+                continue
+            path = os.path.join(self._dir, name)
+            if int(m.group("step")) < floor and path not in keep_paths:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
     # -- restore --
 
     def restore(self) -> bool:
-        """Load the newest snapshot if one exists; returns True on resume.
-        Restores manager progress first so the first quorum reports the
-        resumed step."""
-        path = self.latest()
-        if path is None:
+        """Load the newest complete snapshot if one exists; returns True on
+        resume. Restores manager progress first so the first quorum reports
+        the resumed step. A per-process checkpoint set is merged — sharded
+        leaves pool every writer's shards — before the user callback runs,
+        so ``from_transfer_tree`` can place any device's shard regardless
+        of which host wrote it."""
+        existing = self._existing()
+        if not existing:
             return False
-        with open(path, "rb") as f:
-            state = load_state(f)
+        _, paths = existing[-1]
+        trees = []
+        for path in paths:
+            with open(path, "rb") as f:
+                trees.append(load_state(f))
+        state = trees[0] if len(trees) == 1 else _merge_shard_trees(trees)
         self._manager.load_state_dict(state["torchft"])
         self._load_state_dict(state["user"])
         self._last_saved = self._manager.current_step()
         logger.info(
-            "resumed from %s at step %d", path, self._manager.current_step()
+            "resumed from %s (%d file%s) at step %d",
+            paths[0],
+            len(paths),
+            "" if len(paths) == 1 else "s",
+            self._manager.current_step(),
         )
         return True

@@ -201,12 +201,15 @@ class ShardedArray:
         """Assemble a dense host array (fallback when no mesh is at hand —
         requires the sender's shards to cover the global array)."""
         out = np.empty(self.shape, dtype=self.dtype)
-        covered = 0
+        # Boolean coverage mask, not a summed element count: overlapping
+        # shards would double-count and mask uninitialized gaps (round-2
+        # advisor finding).
+        covered = np.zeros(self.shape, dtype=bool)
         for idx, data in self.shards:
             sl = tuple(slice(a, b) for a, b in idx)
             out[sl] = data
-            covered += data.size
-        if covered < int(np.prod(self.shape)):
+            covered[sl] = True
+        if not covered.all():
             raise ValueError(
                 "shards do not cover the array (multi-host sender); "
                 "rebuild with to_jax(mesh) instead"

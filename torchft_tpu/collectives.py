@@ -52,7 +52,24 @@ __all__ = [
     "CollectivesDummy",
     "ErrorSwallowingCollectives",
     "ManagedCollectives",
+    "PeerGoneError",
 ]
+
+
+class PeerGoneError(ConnectionError):
+    """A socket-level failure talking to a specific peer rank.
+
+    Carries ``peer_rank`` so the Manager can map the ring rank back to a
+    replica_id and file an ``lh.evict`` report — active dead-peer
+    detection that beats the passive heartbeat-lease floor the reference
+    shares (src/lighthouse.rs:119-128)."""
+
+    def __init__(self, peer_rank: int, msg: str = "") -> None:
+        super().__init__(msg or f"connection to peer {peer_rank} failed")
+        self.peer_rank = peer_rank
+
+    def __reduce__(self):  # survive pickling through the proxy backend
+        return (PeerGoneError, (self.peer_rank, str(self)))
 
 
 class ReduceOp(Enum):
@@ -404,13 +421,23 @@ class CollectivesTcp(Collectives):
 
     def _send_to(self, rank: int, tag: int, data: memoryview) -> None:
         p = self._peer(rank)
-        with p.send_lock:
-            _send_frame(p.sock, tag, data)
+        try:
+            with p.send_lock:
+                _send_frame(p.sock, tag, data)
+        except (ConnectionError, OSError) as e:
+            if isinstance(e, (socket.timeout, TimeoutError)):
+                raise  # slow-but-alive peer: latch the error, don't accuse
+            raise PeerGoneError(rank, f"send to peer {rank} failed: {e}") from e
 
     def _recv_from(self, rank: int, tag: int) -> bytearray:
         p = self._peer(rank)
-        with p.recv_lock:
-            return _recv_frame(p.sock, tag)
+        try:
+            with p.recv_lock:
+                return _recv_frame(p.sock, tag)
+        except (ConnectionError, OSError) as e:
+            if isinstance(e, (socket.timeout, TimeoutError)):
+                raise
+            raise PeerGoneError(rank, f"recv from peer {rank} failed: {e}") from e
 
     def _exchange(
         self, dst: int, send_data: memoryview, src: int, tag: int

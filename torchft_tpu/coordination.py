@@ -47,6 +47,9 @@ class QuorumResult:
     # any local rank of this group heals → the group contributes zeros on
     # every rank plane (participation must be plane-consistent)
     group_heal: bool = False
+    # quorum members' replica_ids in replica_rank order — lets the data
+    # plane map a failed peer's ring rank to a replica_id for evict reports
+    participant_ids: List[str] = field(default_factory=list)
 
     @staticmethod
     def _from_wire(d: Dict[str, Any]) -> "QuorumResult":
@@ -63,6 +66,10 @@ class QuorumResult:
             max_world_size=d.get("max_world_size", 1),
             heal=d.get("heal", False),
             group_heal=d.get("group_heal", d.get("heal", False)),
+            participant_ids=[
+                s if isinstance(s, str) else s.decode()
+                for s in d.get("participant_ids", [])
+            ],
         )
 
 
@@ -83,6 +90,7 @@ class LighthouseServer:
         join_timeout_ms: Optional[int] = None,
         quorum_tick_ms: Optional[int] = None,
         heartbeat_timeout_ms: Optional[int] = None,
+        evict_probe_ms: Optional[int] = None,
     ) -> None:
         self._handle, self._address = _native.lighthouse_create(
             bind,
@@ -90,6 +98,7 @@ class LighthouseServer:
             join_timeout_ms if join_timeout_ms is not None else 100,
             quorum_tick_ms if quorum_tick_ms is not None else 100,
             heartbeat_timeout_ms if heartbeat_timeout_ms is not None else 5000,
+            evict_probe_ms if evict_probe_ms is not None else 100,
         )
 
     def address(self) -> str:
@@ -205,6 +214,17 @@ class ManagerClient:
     def kill(self, msg: str = "", timeout: timedelta = timedelta(seconds=10)) -> None:
         self._client.call("mgr.kill", {"msg": msg}, _ms(timeout))
 
+    def evict(
+        self, victim: str, timeout: timedelta = timedelta(seconds=5)
+    ) -> bool:
+        """Report ``victim`` (a replica_id seen dead on the data plane) for
+        immediate eviction. The manager forwards to the lighthouse, which
+        probes the victim's manager address before expiring its heartbeat —
+        a false report about a live peer is a no-op. Returns whether the
+        victim was actually evicted."""
+        resp = self._client.call("mgr.evict", {"victim": victim}, _ms(timeout))
+        return bool(resp.get("evicted", False))
+
     def close(self) -> None:
         self._client.close()
 
@@ -227,6 +247,18 @@ class LighthouseClient:
     ) -> Dict[str, Any]:
         resp = self._client.call("lh.quorum", {"requester": requester}, _ms(timeout))
         return resp["quorum"]
+
+    def evict(
+        self,
+        reporter: str,
+        victim: str,
+        timeout: timedelta = timedelta(seconds=5),
+    ) -> bool:
+        """Direct eviction report (see :meth:`ManagerClient.evict`)."""
+        resp = self._client.call(
+            "lh.evict", {"reporter": reporter, "victim": victim}, _ms(timeout)
+        )
+        return bool(resp.get("evicted", False))
 
     def close(self) -> None:
         self._client.close()

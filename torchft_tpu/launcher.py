@@ -167,6 +167,11 @@ def launch(
                             len(groups) + 1,
                             min_needed,
                         )
+                        # the peers finished clean but THIS group crashed at
+                        # the tail (e.g. during its final step/checkpoint):
+                        # the launcher's 0-iff-every-group-finished-clean
+                        # contract still holds (round-2 advisor finding)
+                        exit_code = 1
                         continue
                     if group.restarts < max_restarts:
                         fresh = _spawn_group(

@@ -25,6 +25,7 @@ import concurrent.futures
 import logging
 import os
 import socket
+import threading
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
@@ -205,12 +206,15 @@ class Manager:
             self._store.set(REPLICA_ID_KEY, replica_id)
 
         addr = self._store.get(MANAGER_ADDR_KEY).decode()
+        self._manager_addr = addr
         self._client = ManagerClient(addr, connect_timeout=connect_timeout)
         replica_id = self._store.get(REPLICA_ID_KEY).decode()
         self._logger = _ManagerLogger(self, replica_id or "", rank)
 
         self._step = 0
         self._quorum_id = -1
+        self._participant_ids: List[str] = []  # replica_rank -> replica_id
+        self._evicted: set = set()  # victims already reported this epoch
         self._commit_failures = 0  # pending data-plane flush request
         self._errored: Optional[Exception] = None
         self._healing = False
@@ -324,6 +328,9 @@ class Manager:
             ):
                 self._participating_rank = None
 
+        self._participant_ids = quorum.participant_ids
+        self._evicted.clear()
+
         if quorum.quorum_id != self._quorum_id:
             # epoch-scoped rendezvous namespace on the primary's store
             store_prefixed_addr = (
@@ -338,6 +345,8 @@ class Manager:
             self._quorum_id = quorum.quorum_id
             # fresh epoch: the flush request (if any) has been honored
             self._commit_failures = 0
+            if self._rank == 0:
+                self._sweep_stale_epochs(quorum.quorum_id)
 
         if allow_heal:
             if quorum.recover_dst_ranks:
@@ -387,6 +396,32 @@ class Manager:
                 # load_state_dict above already restores it, but being
                 # explicit keeps the invariant obvious
                 self._step = quorum.max_step
+
+    def _sweep_stale_epochs(self, current_qid: int) -> None:
+        """GC rendezvous keys from dead epochs (round-2 verdict weak #5).
+
+        Every quorum epoch writes ``coll/addr/*`` keys under
+        ``torchft/{quorum_id}/...`` on the primary's store and nothing else
+        deletes them, so long jobs with flush re-quorums grow the store
+        without bound. Each group's rank 0 sweeps its *own* store on every
+        reconfigure, keeping one epoch of slack for groups still dialing
+        the previous epoch. Best-effort: a failed sweep never fails the
+        quorum."""
+        try:
+            for key in self._store.keys("torchft/"):
+                if isinstance(key, bytes):
+                    key = key.decode()
+                parts = key.split("/")
+                if len(parts) < 2 or parts[0] != "torchft":
+                    continue
+                try:
+                    qid = int(parts[1])
+                except ValueError:
+                    continue
+                if qid < current_qid - 1:
+                    self._store.delete(key)
+        except Exception as ex:  # noqa: BLE001 — GC must never fail a step
+            self._logger.warn(f"epoch GC failed: {ex}")
 
     def _apply_pending_state_dict(self) -> None:
         assert self._healing, "must be in healing state"
@@ -461,8 +496,53 @@ class Manager:
 
     def report_error(self, e: Exception) -> None:
         """Latch an error: the current step will not commit and the data
-        plane reconfigures on the next quorum."""
+        plane reconfigures on the next quorum. If the error names a dead
+        peer (:class:`~torchft_tpu.collectives.PeerGoneError`), its
+        replica is reported to the lighthouse for immediate eviction so
+        the re-quorum doesn't wait out the heartbeat lease."""
         self._errored = e
+        self._maybe_evict(e)
+
+    def _maybe_evict(self, e: BaseException) -> None:
+        """Fire-and-forget lh.evict for a PeerGoneError's peer. Runs on a
+        daemon thread: the report is an optimization (the lease still
+        expires passively) and must never block or fail the training
+        thread."""
+        peer: Optional[int] = None
+        seen = 0
+        cause: Optional[BaseException] = e
+        while cause is not None and seen < 8:  # unwrap chained causes
+            peer = getattr(cause, "peer_rank", None)
+            if peer is not None:
+                break
+            cause = cause.__cause__ or cause.__context__
+            seen += 1
+        if peer is None or not (0 <= peer < len(self._participant_ids)):
+            return
+        victim = self._participant_ids[peer]
+        if victim in self._evicted:
+            return
+        self._evicted.add(victim)
+
+        def _report() -> None:
+            # Fresh client: self._client serializes calls on one socket, so
+            # the report would otherwise park behind an in-flight long-poll
+            # quorum call — the exact wait eviction exists to skip.
+            try:
+                client = ManagerClient(
+                    self._manager_addr, connect_timeout=timedelta(seconds=5)
+                )
+                try:
+                    evicted = client.evict(victim, timeout=timedelta(seconds=5))
+                finally:
+                    client.close()
+                self._logger.info(
+                    f"reported dead peer {victim}: evicted={evicted}"
+                )
+            except Exception as ex:  # noqa: BLE001 — best effort
+                self._logger.warn(f"evict report for {victim} failed: {ex}")
+
+        threading.Thread(target=_report, daemon=True, name="tft_evict").start()
 
     def errored(self) -> Optional[Exception]:
         return self._errored

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import multiprocessing as mp
+import os
 import threading
 from datetime import timedelta
 from multiprocessing import shared_memory
@@ -47,6 +48,10 @@ __all__ = ["CollectivesProxy"]
 
 # below this total, pickling through the queue beats shm setup syscalls
 _SHM_MIN_BYTES = 1 << 16
+# the child attaches via /dev/shm/{name}, which only exists on Linux; on
+# other POSIX platforms the pickle path works everywhere (round-2 advisor
+# finding). Platform property — computed once, not per op.
+_HAS_DEV_SHM = os.path.isdir("/dev/shm")
 
 
 def _buf_views(buf, metas: List[Tuple[int, Tuple[int, ...], str]]) -> List[np.ndarray]:
@@ -278,8 +283,13 @@ class CollectivesProxy(Collectives):
 
     def allreduce(self, arrays, op: ReduceOp = ReduceOp.SUM) -> Work:
         total = sum(getattr(a, "nbytes", 0) for a in arrays)
-        if total >= _SHM_MIN_BYTES and all(
-            isinstance(a, np.ndarray) and a.flags.c_contiguous for a in arrays
+        if (
+            total >= _SHM_MIN_BYTES
+            and _HAS_DEV_SHM
+            and all(
+                isinstance(a, np.ndarray) and a.flags.c_contiguous
+                for a in arrays
+            )
         ):
             return self._allreduce_shm(arrays, op)
         return self._copy_back(self._submit("allreduce", arrays, op), arrays)
