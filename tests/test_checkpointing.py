@@ -258,6 +258,53 @@ def test_collectives_transport_roundtrip():
         store.shutdown()
 
 
+def test_collectives_transport_parallel_fanout_windowed():
+    """Round-3: ≤3 in-flight buffers per destination, destinations in
+    parallel (the reference's pg_transport.py:171-198 pipeline). A
+    many-buffer state dict to TWO healing replicas at once must land
+    intact on both."""
+    store = StoreServer()
+    state = {f"leaf{i}": np.full(4096, float(i), dtype=np.float32) for i in range(24)}
+    try:
+        colls = [CollectivesTcp(timeout=timedelta(seconds=20)) for _ in range(3)]
+        with ThreadPoolExecutor(max_workers=3) as pool:
+            list(
+                pool.map(
+                    lambda i: colls[i].configure(store.address(), i, 3), range(3)
+                )
+            )
+        transports = [
+            CollectivesTransport(c, timeout=timedelta(seconds=20)) for c in colls
+        ]
+
+        with ThreadPoolExecutor(max_workers=3) as pool:
+            fs = pool.submit(
+                transports[0].send_checkpoint,
+                [1, 2],
+                5,
+                state,
+                timedelta(seconds=20),
+            )
+            frs = [
+                pool.submit(
+                    transports[r].recv_checkpoint,
+                    0,
+                    "<collectives>",
+                    5,
+                    timedelta(seconds=20),
+                )
+                for r in (1, 2)
+            ]
+            fs.result(timeout=30)
+            outs = [fr.result(timeout=30) for fr in frs]
+        for out in outs:
+            assert_state_equal(state, out)
+        for c in colls:
+            c.shutdown()
+    finally:
+        store.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # DiskCheckpointer (periodic user-owned checkpoints; reference workflow
 # train_ddp.py:141-148 + manager.py:83-85 docs)

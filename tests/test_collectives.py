@@ -243,6 +243,124 @@ class TestMultiRank:
         colls[2].shutdown()
 
 
+class TestWirePipeline:
+    """Round-3 data-plane upgrades: bf16 wire compression, tag-matched
+    receives surviving out-of-order concurrent p2p traffic, and windowed
+    (≤k in flight) transfer pipelines — the host-path answer to the role
+    NCCL's async streams play in the reference
+    (process_group.py:431-447)."""
+
+    def test_bf16_wire_allreduce(self, store):
+        def fn_factory(wire):
+            def fn(c, rank):
+                arr = np.linspace(
+                    -3.0, 3.0, 4099, dtype=np.float32
+                ) * (rank + 1)
+                return c.allreduce([arr], ReduceOp.AVG).wait(
+                    timedelta(seconds=20)
+                )[0]
+
+            return fn
+
+        colls = [
+            CollectivesTcp(
+                timeout=timedelta(seconds=10),
+                hostname="localhost",
+                wire_dtype="bfloat16",
+            )
+            for _ in range(3)
+        ]
+
+        def start(rank):
+            colls[rank].configure(f"{store.address()}/bf16w", rank, 3)
+            try:
+                return fn_factory("bfloat16")(colls[rank], rank)
+            finally:
+                colls[rank].shutdown()
+
+        with ThreadPoolExecutor(max_workers=3) as ex:
+            outs = list(ex.map(start, range(3)))
+
+        expect = np.linspace(-3.0, 3.0, 4099, dtype=np.float32) * 2.0
+        for out in outs:
+            assert out.dtype == np.float32
+            # bf16 has ~3 decimal digits; per-hop requantization over a
+            # 3-ring stays within a few ulps of that
+            np.testing.assert_allclose(out, expect, rtol=3e-2, atol=3e-2)
+
+    def test_out_of_order_tags_are_matched(self, store):
+        # rank 0 sends tag B then tag A; rank 1 waits for A first: the
+        # B frame must be stashed, not declared a desync
+        def fn(c, rank):
+            if rank == 0:
+                c.send(np.full(4, 7.0, dtype=np.float32), dst=1, tag=22).wait()
+                c.send(np.full(4, 5.0, dtype=np.float32), dst=1, tag=11).wait()
+                return None
+            a = np.zeros(4, dtype=np.float32)
+            b = np.zeros(4, dtype=np.float32)
+            wa = c.recv(a, src=0, tag=11)
+            wb = c.recv(b, src=0, tag=22)
+            wa.wait(timedelta(seconds=10))
+            wb.wait(timedelta(seconds=10))
+            return a, b
+
+        outs = _run_world(store, 2, fn, prefix="ooo")
+        a, b = outs[1]
+        np.testing.assert_allclose(a, 5.0)
+        np.testing.assert_allclose(b, 7.0)
+
+    def test_windowed_p2p_pipeline(self, store):
+        # ≤3 concurrent sends/recvs with per-buffer tags complete and land
+        # in the right buffers (the checkpoint-transport schedule)
+        n_bufs, size = 10, 2048
+
+        def fn(c, rank):
+            if rank == 0:
+                works = []
+                for i in range(n_bufs):
+                    works.append(
+                        c.send(
+                            np.full(size, float(i), dtype=np.float32),
+                            dst=1,
+                            tag=100 + i,
+                        )
+                    )
+                    while len(works) >= 3:
+                        works.pop(0).wait(timedelta(seconds=10))
+                for w in works:
+                    w.wait(timedelta(seconds=10))
+                return None
+            bufs = [np.zeros(size, dtype=np.float32) for _ in range(n_bufs)]
+            works = [
+                c.recv(bufs[i], src=0, tag=100 + i) for i in range(n_bufs)
+            ]
+            for w in works:
+                w.wait(timedelta(seconds=20))
+            return bufs
+
+        outs = _run_world(store, 2, fn, prefix="win")
+        for i, buf in enumerate(outs[1]):
+            np.testing.assert_allclose(buf, float(i))
+
+    def test_p2p_overlaps_ring_traffic(self, store):
+        # a checkpoint-style p2p transfer issued while ring allreduces run
+        # on the op thread: tag matching keeps both streams intact
+        def fn(c, rank):
+            ring = np.full(4096, float(rank + 1), dtype=np.float32)
+            ar = c.allreduce([ring], ReduceOp.SUM)
+            if rank == 0:
+                pw = c.send(np.arange(512, dtype=np.float32), dst=1, tag=9)
+            else:
+                side = np.zeros(512, dtype=np.float32)
+                pw = c.recv(side, src=0, tag=9)
+            ar.wait(timedelta(seconds=20))
+            pw.wait(timedelta(seconds=20))
+            return ring if rank == 0 else (ring, )
+
+        outs = _run_world(store, 2, fn, prefix="olap")
+        np.testing.assert_allclose(outs[0], 3.0)
+
+
 class TestWedgedPeers:
     """Round-1 review weak #2: a dead/silent peer must not wedge the op
     thread forever, and teardown must not leak blocked threads

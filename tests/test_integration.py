@@ -393,3 +393,59 @@ def test_quorum_timeout():
             manager.shutdown(wait=False)
         store.shutdown()
         lighthouse.shutdown()
+
+
+def test_pipelined_multibucket_averaging():
+    """Round-3: the host path's per-bucket pipeline (D2H ‖ ring ‖ H2D)
+    must produce exact averages across groups with many buckets in
+    flight, device-array inputs coming back as device arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchft_tpu.ddp import allreduce_gradients
+
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+    n_leaves = 6
+
+    def one_group(gid: int):
+        store = StoreServer()
+        manager = Manager(
+            collectives=CollectivesTcp(timeout=timedelta(seconds=10)),
+            load_state_dict=lambda s: None,
+            state_dict=lambda: {},
+            min_replica_size=2,
+            replica_id=f"pipe{gid}",
+            store_addr=store.address(),
+            rank=0,
+            world_size=1,
+            lighthouse_addr=lighthouse.address(),
+            timeout=timedelta(seconds=10),
+            # sync quorum: every group participates from step 0 (the async
+            # bootstrap group-heal gate is covered by test_ddp_recovery)
+            use_async_quorum=False,
+        )
+        try:
+            grads = {
+                f"g{i}": jnp.full((64, 3), float(gid * 10 + i), jnp.float32)
+                for i in range(n_leaves)
+            }
+            manager.start_quorum()
+            # 256-byte buckets force one bucket per leaf: ≥6 pipelined ops
+            avg = allreduce_gradients(manager, grads, bucket_bytes=256)
+            committed = manager.should_commit()
+            return avg, committed
+        finally:
+            manager.shutdown(wait=False)
+            store.shutdown()
+
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        outs = list(ex.map(one_group, range(2)))
+
+    for avg, committed in outs:
+        assert committed
+        for i in range(n_leaves):
+            # mean of gid 0 and 1 leaves: (i + 10+i)/2 = i + 5
+            leaf = avg[f"g{i}"]
+            assert isinstance(leaf, jax.Array)  # H2D already dispatched
+            np.testing.assert_allclose(np.asarray(leaf), float(i + 5))
+    lighthouse.shutdown()

@@ -1,0 +1,260 @@
+"""Cross-process gradient-plane benchmark.
+
+The round-2 review's top gap: nothing measured what the host data plane
+(separate-process replica groups → D2H + TCP ring + H2D per step, the
+topology of the BASELINE north-star 4×8-chip job) costs at 7B scale, and
+the serial path left D2H, wire and H2D time additive.
+
+This tool runs the REAL path: two replica groups as separate OS processes,
+each with a full Manager (C++ lighthouse + quorum + commit) and a
+``CollectivesTcp`` ring, averaging a synthetic gradient pytree through
+``allreduce_gradients`` — once with the round-3 per-bucket pipeline, once
+with the round-2 serial schedule (all transfers, then one wire op), with
+and without bf16 wire compression. From the measured bytes/s it derives
+the per-step averaging cost of the llama2-7b preset (the number the
+review asked for), labeled as derived, not measured.
+
+Usage::
+
+    python -m torchft_tpu.benchmarks.crossgroup [--total-mb 256]
+
+(Workers force ``JAX_PLATFORMS=cpu`` so the bench never competes with a
+training job for the local chip; the wire path is identical either way —
+only the D2H/H2D legs differ, and those are measured separately by the
+headline bench on real HBM.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from datetime import timedelta
+from typing import Dict, List, Optional
+
+# llama2-7b preset (examples/train_hsdp.py PRESETS) parameter count:
+# embeddings + 32 × (4·d² attn + 3·d·d_ff mlp + 2·d norms) + final norm
+# + (tied) output head — matches models/transformer.py's layout.
+_7B_D, _7B_FF, _7B_L, _7B_V = 4096, 11008, 32, 32000
+LLAMA2_7B_PARAMS = (
+    _7B_V * _7B_D
+    + _7B_L * (4 * _7B_D * _7B_D + 3 * _7B_D * _7B_FF + 2 * _7B_D)
+    + _7B_D
+    + _7B_V * _7B_D
+)
+
+
+def _worker_main(argv: List[str]) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gid", type=int, required=True)
+    parser.add_argument("--lighthouse", required=True)
+    parser.add_argument("--total-mb", type=float, required=True)
+    parser.add_argument("--rounds", type=int, required=True)
+    parser.add_argument("--wire-dtype", default="")
+    parser.add_argument("--serial", action="store_true")
+    args = parser.parse_args(argv)
+
+    import numpy as np
+
+    from torchft_tpu.collectives import CollectivesTcp
+    from torchft_tpu.ddp import allreduce_gradients, flatten_buckets
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.store import StoreServer
+
+    import jax
+    import jax.numpy as jnp
+
+    # JAX_PLATFORMS=cpu alone loses to the container's TPU PJRT plugin
+    # (sitecustomize); pin explicitly so the worker never occupies the chip
+    # or pays tunnel transfers
+    jax.config.update("jax_platforms", "cpu")
+
+    store = StoreServer()
+    manager = Manager(
+        collectives=CollectivesTcp(
+            timeout=timedelta(seconds=120),
+            hostname="localhost",
+            wire_dtype=args.wire_dtype or None,
+        ),
+        load_state_dict=lambda s: None,
+        state_dict=lambda: {},
+        min_replica_size=2,
+        replica_id=f"xg{args.gid}",
+        store_addr=store.address(),
+        rank=0,
+        world_size=1,
+        lighthouse_addr=args.lighthouse,
+        timeout=timedelta(seconds=120),
+        quorum_timeout=timedelta(seconds=120),
+        use_async_quorum=False,
+    )
+    try:
+        # ~4 MB leaves → ~25 MB buckets hold ~6 each; jnp so the full
+        # leaf→host→ring→device path runs
+        leaf_elems = 1 << 20
+        n_leaves = max(1, int(args.total_mb * 1024 * 1024 / 4 / leaf_elems))
+        rng = np.random.default_rng(args.gid)
+        grads = {
+            f"g{i}": jnp.asarray(
+                rng.standard_normal(leaf_elems).astype(np.float32)
+            )
+            for i in range(n_leaves)
+        }
+        total_bytes = n_leaves * leaf_elems * 4
+
+        def serial_round() -> None:
+            # the round-2 schedule: every leaf to host first, then ONE
+            # managed op over all buckets, then back
+            host = [np.ascontiguousarray(np.asarray(v)) for v in grads.values()]
+            buckets = flatten_buckets(host)
+            manager.allreduce_many([b for b, _ in buckets]).wait()
+            for b, _ in buckets:
+                jnp.asarray(b)
+
+        def pipelined_round() -> None:
+            allreduce_gradients(manager, grads)
+
+        run = serial_round if args.serial else pipelined_round
+
+        # warmup (also forms the quorum)
+        manager.start_quorum()
+        run()
+        assert manager.should_commit(), "warmup step failed to commit"
+
+        t0 = time.perf_counter()
+        for _ in range(args.rounds):
+            manager.start_quorum()
+            run()
+            assert manager.should_commit(), "bench step failed to commit"
+        elapsed = (time.perf_counter() - t0) / args.rounds
+
+        print(
+            json.dumps(
+                {
+                    "gid": args.gid,
+                    "seconds_per_round": elapsed,
+                    "total_bytes": total_bytes,
+                }
+            ),
+            flush=True,
+        )
+    finally:
+        manager.shutdown(wait=False)
+        store.shutdown()
+
+
+def _run_pair(
+    lighthouse_addr: str,
+    total_mb: float,
+    rounds: int,
+    wire_dtype: str,
+    serial: bool,
+) -> Dict[str, float]:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    procs = []
+    for gid in range(2):
+        cmd = [
+            sys.executable,
+            "-m",
+            "torchft_tpu.benchmarks.crossgroup",
+            "--worker",
+            "--gid",
+            str(gid),
+            "--lighthouse",
+            lighthouse_addr,
+            "--total-mb",
+            str(total_mb),
+            "--rounds",
+            str(rounds),
+            "--wire-dtype",
+            wire_dtype,
+        ]
+        if serial:
+            cmd.append("--serial")
+        procs.append(
+            subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env
+            )
+        )
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"crossgroup worker failed rc={p.returncode}: "
+                f"{err.decode()[-2000:]}"
+            )
+        results.append(json.loads(out.decode().strip().splitlines()[-1]))
+    secs = max(r["seconds_per_round"] for r in results)
+    total_bytes = results[0]["total_bytes"]
+    return {
+        "seconds_per_round": secs,
+        "gb_per_sec": total_bytes / secs / 1e9,
+        "total_bytes": total_bytes,
+    }
+
+
+def measure_crossgroup(
+    total_mb: float = 256.0, rounds: int = 3
+) -> Dict[str, object]:
+    """Run the 2-process averaging matrix; returns the bench dict."""
+    from torchft_tpu.coordination import LighthouseServer
+
+    out: Dict[str, object] = {
+        "topology": "2 replica groups, separate OS processes, TCP ring "
+        "(DCN analogue) through full Manager quorum+commit",
+        "tree_mb": total_mb,
+    }
+    grad_bytes_7b = LLAMA2_7B_PARAMS * 4  # f32 gradient tree
+
+    variants = {
+        "serial_r2": dict(wire_dtype="", serial=True),
+        "pipelined": dict(wire_dtype="", serial=False),
+        "pipelined_bf16_wire": dict(wire_dtype="bfloat16", serial=False),
+    }
+    for name, kw in variants.items():
+        lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+        try:
+            res = _run_pair(
+                lighthouse.address(), total_mb, rounds, **kw
+            )
+        finally:
+            lighthouse.shutdown()
+        res["derived_llama2_7b_avg_s"] = round(
+            grad_bytes_7b * res["seconds_per_round"] / res["total_bytes"], 2
+        )
+        res["seconds_per_round"] = round(res["seconds_per_round"], 4)
+        res["gb_per_sec"] = round(res["gb_per_sec"], 3)
+        del res["total_bytes"]
+        out[name] = res
+
+    ser = out["serial_r2"]["seconds_per_round"]  # type: ignore[index]
+    pipe = out["pipelined"]["seconds_per_round"]  # type: ignore[index]
+    out["pipeline_speedup"] = round(ser / pipe, 3) if pipe else None
+    out["note"] = (
+        "derived_llama2_7b_avg_s extrapolates measured bytes/s to the 7B "
+        "preset's f32 gradient tree (bf16 wire halves DCN bytes); workers "
+        "run on CPU so the wire path is measured without occupying the chip"
+    )
+    return out
+
+
+def main() -> None:
+    if "--worker" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--worker"]
+        _worker_main(argv)
+        return
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--total-mb", type=float, default=256.0)
+    parser.add_argument("--rounds", type=int, default=3)
+    args = parser.parse_args()
+    print(json.dumps(measure_crossgroup(args.total_mb, args.rounds), indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -5,13 +5,23 @@ pickled meta message (treedef + per-leaf dtype/shape/nbytes) followed by the
 raw array buffers over the Collectives send/recv pairs created for the
 current quorum. Useful when the control network is slow but the data plane
 is fast; on TPU pods this is the DCN path.
+
+Transfers are pipelined the way the reference bounds them
+(pg_transport.py:171-198): at most ``_WINDOW`` buffer sends are in flight
+per destination — enough to overlap serialization with socket I/O without
+holding the whole state dict's worth of wire buffers — and fan-out to
+several healing replicas runs destinations in parallel. Each buffer gets
+its own wire tag so the overlapped frames can complete out of order; the
+receiver windows its recvs the same way and lands each buffer directly in
+its preallocated array (zero-copy ``into=`` receive).
 """
 
 from __future__ import annotations
 
 import logging
+from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
-from typing import Generic, List, TypeVar
+from typing import Deque, Generic, List, TypeVar
 
 import numpy as np
 
@@ -32,7 +42,17 @@ __all__ = ["CollectivesTransport"]
 
 # Distinct tag space from training-loop traffic; see collectives.py tag map.
 _META_TAG = 0x00CC01
-_DATA_TAG = 0x00CC02
+# Per-buffer data tags cycle within a 4096 window: in-flight reordering is
+# bounded by _WINDOW (≪ 4096), so a cycled tag can never collide with a
+# frame still in flight.
+_DATA_TAG0 = 0x0D0000
+_TAG_CYCLE = 4096
+_WINDOW = 3
+_MAX_DST_PARALLEL = 4
+
+
+def _data_tag(i: int) -> int:
+    return _DATA_TAG0 + (i % _TAG_CYCLE)
 
 
 class CollectivesTransport(CheckpointTransport[T], Generic[T]):
@@ -43,23 +63,57 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
     def metadata(self) -> str:
         return "<collectives>"
 
+    def _send_one(
+        self,
+        dst: int,
+        len_arr: np.ndarray,
+        hdr_arr: np.ndarray,
+        buffers: List[np.ndarray],
+        timeout: timedelta,
+    ) -> None:
+        from collections import deque
+
+        self._collectives.send(len_arr, dst, tag=_META_TAG).wait(timeout)
+        self._collectives.send(hdr_arr, dst, tag=_META_TAG).wait(timeout)
+        window: Deque = deque()
+        for i, buf in enumerate(buffers):
+            while len(window) >= _WINDOW:
+                window.popleft().wait(timeout)
+            window.append(
+                self._collectives.send(
+                    np.frombuffer(as_bytes(buf), dtype=np.uint8),
+                    dst,
+                    tag=_data_tag(i),
+                )
+            )
+        while window:
+            window.popleft().wait(timeout)
+
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: T, timeout: timedelta
     ) -> None:
         header, buffers = flatten_state(state_dict)
         hdr_arr = np.frombuffer(header, dtype=np.uint8)
         len_arr = np.array([len(header)], dtype=np.int64)
-        for dst in dst_ranks:
-            self._collectives.send(len_arr, dst, tag=_META_TAG).wait(timeout)
-            self._collectives.send(hdr_arr, dst, tag=_META_TAG).wait(timeout)
-            for buf in buffers:
-                self._collectives.send(
-                    np.frombuffer(as_bytes(buf), dtype=np.uint8), dst, tag=_DATA_TAG
-                ).wait(timeout)
+        if len(dst_ranks) == 1:
+            self._send_one(dst_ranks[0], len_arr, hdr_arr, buffers, timeout)
+            return
+        with ThreadPoolExecutor(
+            max_workers=min(_MAX_DST_PARALLEL, len(dst_ranks)),
+            thread_name_prefix="tft_ckpt_send",
+        ) as pool:
+            futs = [
+                pool.submit(self._send_one, dst, len_arr, hdr_arr, buffers, timeout)
+                for dst in dst_ranks
+            ]
+            for f in futs:
+                f.result()
 
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: timedelta
     ) -> T:
+        from collections import deque
+
         len_arr = np.zeros(1, dtype=np.int64)
         self._collectives.recv(len_arr, src_rank, tag=_META_TAG).wait(timeout)
         hdr_arr = np.zeros(int(len_arr[0]), dtype=np.uint8)
@@ -70,8 +124,15 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
 
         _, infos = pickle.loads(header)
         buffers: List[np.ndarray] = []
-        for nbytes in buffer_sizes(infos):
+        window: Deque = deque()
+        for i, nbytes in enumerate(buffer_sizes(infos)):
+            while len(window) >= _WINDOW:
+                window.popleft().wait(timeout)
             buf = np.zeros(nbytes, dtype=np.uint8)
-            self._collectives.recv(buf, src_rank, tag=_DATA_TAG).wait(timeout)
             buffers.append(buf)
+            window.append(
+                self._collectives.recv(buf, src_rank, tag=_data_tag(i))
+            )
+        while window:
+            window.popleft().wait(timeout)
         return unflatten_state(header, buffers)

@@ -225,15 +225,30 @@ class DiskCheckpointer:
                     path,
                     int(m.group("pcount")),
                 )
-        out: List[Tuple[int, List[str]]] = [
-            (step, [path]) for step, path in dense.items()
-        ]
+        complete_procs: dict = {}
         for step, by_idx in procs.items():
             counts = {pcount for _, pcount in by_idx.values()}
             if len(counts) == 1 and len(by_idx) == next(iter(counts)):
-                out.append(
-                    (step, [by_idx[i][0] for i in sorted(by_idx)])
-                )
+                complete_procs[step] = [by_idx[i][0] for i in sorted(by_idx)]
+
+        def _mtime(paths: List[str]) -> float:
+            try:
+                return max(os.path.getmtime(p) for p in paths)
+            except OSError:
+                return 0.0
+
+        out: List[Tuple[int, List[str]]] = []
+        for step in dense.keys() | complete_procs.keys():
+            # one entry per step: an elastic resize can leave BOTH a dense
+            # file and a stale complete procIofN set (or vice versa) at the
+            # same step — offer only the newer write, never a stale merge
+            if step in dense and step in complete_procs:
+                d, p = [dense[step]], complete_procs[step]
+                out.append((step, d if _mtime(d) >= _mtime(p) else p))
+            elif step in dense:
+                out.append((step, [dense[step]]))
+            else:
+                out.append((step, complete_procs[step]))
         return sorted(out)
 
     def latest(self) -> Optional[str]:
@@ -355,7 +370,6 @@ class DiskCheckpointer:
         if not kept:
             return
         floor = kept[0][0]
-        keep_paths = {p for _, paths in kept for p in paths}
         try:
             names = os.listdir(self._dir)
         except FileNotFoundError:
@@ -365,7 +379,9 @@ class DiskCheckpointer:
             if not m or m.group("tag") != self._tag:
                 continue
             path = os.path.join(self._dir, name)
-            if int(m.group("step")) < floor and path not in keep_paths:
+            # every kept entry has step >= floor, so step < floor alone
+            # proves the file is not retained
+            if int(m.group("step")) < floor:
                 try:
                     os.remove(path)
                 except OSError:

@@ -141,7 +141,14 @@ class Collectives(ABC):
 
     @abstractmethod
     def recv(self, arr: np.ndarray, src: int, tag: int = 0) -> Work:
-        """In-place receive into ``arr``."""
+        """In-place receive into ``arr``.
+
+        Point-to-point ops run concurrently (a worker pool, not the
+        ordered collective-op thread). Frames are matched by ``tag``, so
+        several outstanding recvs from one peer are safe with *distinct*
+        tags; two concurrent recvs on the SAME (src, tag) race for frames
+        in unspecified order — serialize them with ``wait()`` or use
+        per-message tags (see checkpointing/collectives_transport.py)."""
 
     @abstractmethod
     def barrier(self) -> Work: ...
@@ -169,24 +176,20 @@ def _send_frame(sock: socket.socket, tag: int, payload: memoryview) -> None:
     sock.sendall(payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytearray:
-    buf = bytearray(n)
-    view = memoryview(buf)
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    n = len(view)
     got = 0
     while got < n:
         k = sock.recv_into(view[got:], n - got)
         if k == 0:
             raise ConnectionError("peer closed connection")
         got += k
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
     return buf
-
-
-def _recv_frame(sock: socket.socket, expect_tag: int) -> bytearray:
-    hdr = _recv_exact(sock, _FRAME_HDR.size)
-    tag, length = _FRAME_HDR.unpack(bytes(hdr))
-    if tag != expect_tag:
-        raise RuntimeError(f"collective desync: got tag {tag:#x}, want {expect_tag:#x}")
-    return _recv_exact(sock, length)
 
 
 def _bytes_view(arr: np.ndarray) -> memoryview:
@@ -215,6 +218,15 @@ class _Peer:
         # from the same peer (ring steps do exactly that).
         self.send_lock = threading.Lock()
         self.recv_lock = threading.Lock()
+        # Tag-matched receive state: concurrent ops (pipelined checkpoint
+        # buffers, overlapped p2p + ring traffic) may interleave frames on
+        # one socket; the reader thread stashes frames for tags other ops
+        # are waiting on instead of declaring a desync.
+        self.cond = threading.Condition(self.recv_lock)
+        self.stash: Dict[int, List[bytearray]] = {}
+        self.stash_bytes = 0
+        self.reader_busy = False
+        self.recv_error: Optional[BaseException] = None
 
 
 class CollectivesTcp(Collectives):
@@ -230,9 +242,37 @@ class CollectivesTcp(Collectives):
         self,
         timeout: timedelta = timedelta(seconds=60),
         hostname: Optional[str] = None,
+        wire_dtype: Optional[str] = None,
+        p2p_workers: int = 8,
+        stash_limit: int = 1 << 30,
     ) -> None:
+        """
+        Args:
+            wire_dtype: optional on-the-wire compression for float32 ring
+                allreduce — ``"bfloat16"`` halves DCN bytes; partial sums
+                are re-quantized each hop (error ~O(sqrt(world))·2^-8), so
+                it's opt-in, like the reference's NCCL bf16 gradient comms.
+            p2p_workers: thread pool size for send/recv ops — point-to-point
+                transfers (checkpoint fan-out to several healing replicas,
+                windowed buffer pipelines) run concurrently, off the ordered
+                collective-op thread. Tag matching keeps interleaved frames
+                safe (:meth:`_recv_matched`).
+            stash_limit: byte cap on frames parked for tags no local op is
+                consuming — the desync tripwire.
+        """
         self._timeout = timeout
         self._hostname = hostname or socket.gethostname()
+        if wire_dtype:
+            try:
+                self._wire_dtype: Optional[np.dtype] = np.dtype(wire_dtype)
+            except TypeError:
+                import ml_dtypes  # noqa: F401 — registers bfloat16/fp8 names
+
+                self._wire_dtype = np.dtype(wire_dtype)
+        else:
+            self._wire_dtype = None
+        self._p2p_workers = p2p_workers
+        self._stash_limit = stash_limit
         self._rank = -1
         self._world = 0
         self._generation = 0
@@ -242,6 +282,7 @@ class CollectivesTcp(Collectives):
         self._acceptor: Optional[threading.Thread] = None
         self._store = None
         self._executor: Optional[ThreadPoolExecutor] = None
+        self._p2p: Optional[ThreadPoolExecutor] = None
         self._op_seq = 0
 
     # -- lifecycle --
@@ -260,6 +301,9 @@ class CollectivesTcp(Collectives):
             self._executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="tft_coll"
             )
+            self._p2p = ThreadPoolExecutor(
+                max_workers=self._p2p_workers, thread_name_prefix="tft_p2p"
+            )
             return
 
         self._store = create_store_client(store_addr, connect_timeout=self._timeout)
@@ -277,6 +321,9 @@ class CollectivesTcp(Collectives):
         self._acceptor.start()
         self._executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="tft_coll"
+        )
+        self._p2p = ThreadPoolExecutor(
+            max_workers=self._p2p_workers, thread_name_prefix="tft_p2p"
         )
         # Eagerly establish the full mesh so configure() surfaces
         # connectivity failures (and later ops can't stall on dial).
@@ -373,6 +420,9 @@ class CollectivesTcp(Collectives):
         if self._executor is not None:
             self._executor.shutdown(wait=True, cancel_futures=True)
             self._executor = None
+        if self._p2p is not None:
+            self._p2p.shutdown(wait=True, cancel_futures=True)
+            self._p2p = None
         if self._store is not None:
             self._store.close()
             self._store = None
@@ -395,8 +445,14 @@ class CollectivesTcp(Collectives):
             raise RuntimeError(f"no connection to peer {rank}")
         return p
 
-    def _submit(self, fn: Callable) -> Work:
-        assert self._executor is not None, "configure() must be called first"
+    def _submit(self, fn: Callable, p2p: bool = False) -> Work:
+        """Run ``fn`` async. Collective ops share ONE ordered thread (SPMD
+        tag sequencing + natural per-bucket pipelining); point-to-point ops
+        go to the p2p pool so transfers to/from different peers — and
+        windowed buffer pipelines to one peer — overlap. Tag matching in
+        :meth:`_recv_matched` keeps the interleaved frames safe."""
+        executor = self._p2p if p2p else self._executor
+        assert executor is not None, "configure() must be called first"
         out: Future = Future()
 
         def run() -> None:
@@ -405,7 +461,7 @@ class CollectivesTcp(Collectives):
             except BaseException as e:  # noqa: BLE001 — propagate via future
                 out.set_exception(e)
 
-        task = self._executor.submit(run)
+        task = executor.submit(run)
 
         def on_done(t) -> None:
             # teardown cancels queued tasks whose run() never executes; the
@@ -429,22 +485,136 @@ class CollectivesTcp(Collectives):
                 raise  # slow-but-alive peer: latch the error, don't accuse
             raise PeerGoneError(rank, f"send to peer {rank} failed: {e}") from e
 
-    def _recv_from(self, rank: int, tag: int) -> bytearray:
+    def _recv_from(
+        self, rank: int, tag: int, into: Optional[memoryview] = None
+    ) -> Optional[bytearray]:
+        """Tag-matched receive. With ``into``, a frame of exactly
+        ``len(into)`` bytes is received straight into the caller's buffer
+        (zero-copy) and None is returned; otherwise the frame bytes are
+        returned."""
         p = self._peer(rank)
         try:
-            with p.recv_lock:
-                return _recv_frame(p.sock, tag)
+            return self._recv_matched(p, tag, into)
         except (ConnectionError, OSError) as e:
             if isinstance(e, (socket.timeout, TimeoutError)):
-                raise
+                raise  # slow-but-alive peer: latch the error, don't accuse
             raise PeerGoneError(rank, f"recv from peer {rank} failed: {e}") from e
 
+    def _recv_matched(
+        self, p: _Peer, tag: int, into: Optional[memoryview]
+    ) -> Optional[bytearray]:
+        """Core of the concurrent-safe receive path.
+
+        Several ops may receive from the same peer at once (pipelined
+        checkpoint buffers, p2p overlapping ring traffic); frames for one op
+        must not be consumed by another. One thread at a time becomes the
+        socket reader; frames for other tags are parked in the peer's stash
+        and their waiters notified. A hard stash cap keeps a genuine desync
+        (a tag nobody will ever wait for) loud instead of an unbounded leak.
+        """
+        import time
+
+        deadline = time.monotonic() + self._timeout.total_seconds()
+        while True:
+            with p.cond:
+                while True:
+                    if p.recv_error is not None:
+                        # preserve the reader's error *class*: a timeout
+                        # must stay a timeout for waiters too, or a slow-
+                        # but-alive peer gets accused via PeerGoneError
+                        if isinstance(
+                            p.recv_error, (socket.timeout, TimeoutError)
+                        ):
+                            raise TimeoutError(
+                                f"receive stream timed out: {p.recv_error!r}"
+                            ) from p.recv_error
+                        raise ConnectionError(
+                            f"receive stream broken: {p.recv_error!r}"
+                        ) from p.recv_error
+                    q = p.stash.get(tag)
+                    if q:
+                        if into is not None and len(into) != len(q[0]):
+                            # leave the frame stashed: another (correctly
+                            # sized) recv may still claim it
+                            raise RuntimeError(
+                                f"tag {tag:#x}: frame is {len(q[0])} bytes, "
+                                f"recv buffer is {len(into)}"
+                            )
+                        data = q.pop(0)
+                        if not q:
+                            del p.stash[tag]
+                        p.stash_bytes -= len(data)
+                        if into is not None:
+                            into[:] = data
+                            return None
+                        return data
+                    if not p.reader_busy:
+                        p.reader_busy = True
+                        break  # we read the socket
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"recv tag {tag:#x} timed out waiting for reader; "
+                            f"stashed tags: {sorted(map(hex, p.stash))}"
+                        )
+                    p.cond.wait(remaining)
+            got_tag = -1
+            filled = False
+            data = None
+            try:
+                hdr = _recv_exact(p.sock, _FRAME_HDR.size)
+                got_tag, length = _FRAME_HDR.unpack(bytes(hdr))
+                if got_tag == tag and into is not None and len(into) == length:
+                    _recv_exact_into(p.sock, into)
+                    filled = True
+                else:
+                    data = _recv_exact(p.sock, length)
+            except BaseException as e:
+                with p.cond:
+                    p.reader_busy = False
+                    # the stream position is now undefined (possibly mid-
+                    # frame): the epoch is poisoned until reconfigure
+                    p.recv_error = e
+                    p.cond.notify_all()
+                raise
+            with p.cond:
+                p.reader_busy = False
+                if got_tag == tag:
+                    if into is not None and not filled:
+                        # size mismatch: stash the frame (a correctly sized
+                        # recv may claim it) and fail loudly
+                        p.stash.setdefault(got_tag, []).append(data)
+                        p.stash_bytes += len(data)
+                        p.cond.notify_all()
+                        raise RuntimeError(
+                            f"tag {tag:#x}: frame is {len(data)} bytes, "
+                            f"recv buffer is {len(into)}"
+                        )
+                    p.cond.notify_all()
+                    return None if filled else data
+                p.stash.setdefault(got_tag, []).append(data)
+                p.stash_bytes += len(data)
+                over = p.stash_bytes > self._stash_limit
+                p.cond.notify_all()
+                if over:
+                    raise RuntimeError(
+                        f"collective desync: {p.stash_bytes} bytes stashed "
+                        f"while waiting for tag {tag:#x}; stashed tags "
+                        f"{sorted(map(hex, p.stash))}"
+                    )
+
     def _exchange(
-        self, dst: int, send_data: memoryview, src: int, tag: int
-    ) -> bytearray:
+        self,
+        dst: int,
+        send_data: memoryview,
+        src: int,
+        tag: int,
+        into: Optional[memoryview] = None,
+    ) -> Optional[bytearray]:
         """Simultaneously send to dst and receive from src (ring step) —
         the send runs on a helper thread so large transfers can't deadlock
-        on full OS socket buffers."""
+        on full OS socket buffers. With ``into``, the frame lands directly
+        in the caller's scratch buffer (no per-hop allocation)."""
         err: List[BaseException] = []
 
         def do_send() -> None:
@@ -455,7 +625,7 @@ class CollectivesTcp(Collectives):
 
         t = threading.Thread(target=do_send, daemon=True)
         t.start()
-        data = self._recv_from(src, tag)
+        data = self._recv_from(src, tag, into=into)
         t.join()
         if err:
             raise err[0]
@@ -492,27 +662,41 @@ class CollectivesTcp(Collectives):
         bounds = np.linspace(0, flat.size, world + 1).astype(np.int64)
         chunks = [flat[bounds[i] : bounds[i + 1]] for i in range(world)]
 
+        # optional lossy wire compression (f32 → bf16 on the wire, f32
+        # accumulation locally): halves DCN bytes per hop
+        wire = self._wire_dtype
+        compress = wire is not None and arr.dtype == np.float32 and flat.size > 0
+        max_elems = max((int(c.size) for c in chunks), default=0)
+        if compress:
+            scratch = np.empty(max_elems, dtype=wire)
+        else:
+            scratch = np.empty(max_elems, dtype=arr.dtype)
+
+        def pack(chunk: np.ndarray) -> memoryview:
+            return _bytes_view(chunk.astype(wire) if compress else chunk)
+
         # reduce-scatter phase
         for step in range(world - 1):
             send_idx = (rank - step) % world
             recv_idx = (rank - step - 1) % world
-            data = self._exchange(
-                right, _bytes_view(chunks[send_idx]),
-                left, tag,
+            n = int(chunks[recv_idx].size)
+            view = scratch[:n]
+            self._exchange(
+                right, pack(chunks[send_idx]), left, tag, into=_bytes_view(view)
             )
-            incoming = np.frombuffer(data, dtype=arr.dtype)
+            incoming = view.astype(np.float32) if compress else view
             reduce_fn(chunks[recv_idx], incoming.reshape(chunks[recv_idx].shape))
         # allgather phase
         for step in range(world - 1):
             send_idx = (rank + 1 - step) % world
             recv_idx = (rank - step) % world
-            data = self._exchange(
-                right, _bytes_view(chunks[send_idx]),
-                left, tag,
+            n = int(chunks[recv_idx].size)
+            view = scratch[:n]
+            self._exchange(
+                right, pack(chunks[send_idx]), left, tag, into=_bytes_view(view)
             )
-            chunks[recv_idx][:] = np.frombuffer(data, dtype=arr.dtype).reshape(
-                chunks[recv_idx].shape
-            )
+            incoming = view.astype(arr.dtype) if compress else view
+            chunks[recv_idx][:] = incoming.reshape(chunks[recv_idx].shape)
 
     def allgather(self, arr: np.ndarray) -> Work:
         world, rank = self._world, self._rank
@@ -620,17 +804,18 @@ class CollectivesTcp(Collectives):
         def run() -> None:
             self._send_to(dst, wire_tag, _bytes_view(arr))
 
-        return self._submit(run)
+        return self._submit(run, p2p=True)
 
     def recv(self, arr: np.ndarray, src: int, tag: int = 0) -> Work:
         wire_tag = 0x06000000 | (tag & 0xFFFFFF)
 
         def run() -> np.ndarray:
-            data = self._recv_from(src, wire_tag)
-            _flat_view(arr)[:] = np.frombuffer(data, dtype=arr.dtype)
+            _flat_view(arr)  # contiguity check up front, like the old path
+            done = self._recv_from(src, wire_tag, into=_bytes_view(arr))
+            assert done is None, "into-receive must fill in place"
             return arr
 
-        return self._submit(run)
+        return self._submit(run, p2p=True)
 
     def barrier(self) -> Work:
         token = np.zeros(1, dtype=np.int32)

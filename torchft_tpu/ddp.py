@@ -10,11 +10,18 @@ that can resize without recompiling the train step).
 Bucketing mirrors DDP's reducer: leaves are packed into ~25 MB flat
 buffers so each quorum-managed allreduce moves a large contiguous span
 (fewer ring rounds, full-bandwidth frames) instead of one op per leaf.
+
+The host path is a three-stage pipeline, the role NCCL's async stream
+plays in the reference (process_group.py:431-447): while bucket k rides
+the TCP ring on the collectives op thread, bucket k+1's device→host
+transfers complete on the main thread and bucket k−1's averaged pieces
+are already being device_put back — so wire time hides behind transfer
+time instead of adding to it.
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence, Tuple
+from typing import Any, Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -38,26 +45,34 @@ def flatten_buckets(
     together in input order (a dtype change forces a new bucket, as packing
     requires a uniform element type)."""
     buckets: List[Tuple[np.ndarray, List[int]]] = []
+    for idxs in plan_buckets(
+        [(l.dtype, l.nbytes) for l in leaves], bucket_bytes
+    ):
+        buf = np.concatenate([leaves[i].reshape(-1) for i in idxs])
+        buckets.append((buf, idxs))
+    return buckets
+
+
+def plan_buckets(
+    meta: Sequence[Tuple[np.dtype, int]], bucket_bytes: int = _DEFAULT_BUCKET_BYTES
+) -> List[List[int]]:
+    """Group item indices into ~``bucket_bytes`` same-dtype buckets from
+    (dtype, nbytes) metadata alone — so the plan exists before any device
+    buffer has been pulled to host (the pipeline needs it up front)."""
+    plan: List[List[int]] = []
     cur: List[int] = []
     cur_bytes = 0
     cur_dtype = None
-
-    def flush() -> None:
-        nonlocal cur, cur_bytes, cur_dtype
-        if not cur:
-            return
-        buf = np.concatenate([leaves[i].reshape(-1) for i in cur])
-        buckets.append((buf, cur))
-        cur, cur_bytes, cur_dtype = [], 0, None
-
-    for i, leaf in enumerate(leaves):
-        if cur and (leaf.dtype != cur_dtype or cur_bytes + leaf.nbytes > bucket_bytes):
-            flush()
+    for i, (dtype, nbytes) in enumerate(meta):
+        if cur and (dtype != cur_dtype or cur_bytes + nbytes > bucket_bytes):
+            plan.append(cur)
+            cur, cur_bytes = [], 0
         cur.append(i)
-        cur_bytes += leaf.nbytes
-        cur_dtype = leaf.dtype
-    flush()
-    return buckets
+        cur_bytes += nbytes
+        cur_dtype = dtype
+    if cur:
+        plan.append(cur)
+    return plan
 
 
 def unflatten_buckets(
@@ -75,6 +90,25 @@ def unflatten_buckets(
     return out
 
 
+class _Item:
+    """One host transfer unit: a dense leaf or a single shard of a
+    process-spanning leaf. Metadata (dtype/size) is known before the
+    device buffer is, which is what lets buckets be planned up front."""
+
+    __slots__ = ("leaf_pos", "src", "dtype", "shape", "index")
+
+    def __init__(self, leaf_pos, src, dtype, shape, index=None) -> None:
+        self.leaf_pos = leaf_pos
+        self.src = src  # jax.Array / shard data / numpy
+        self.dtype = np.dtype(dtype)
+        self.shape = tuple(shape)
+        self.index = index  # shard index desc, or None for dense
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) * self.dtype.itemsize
+
+
 def allreduce_gradients(
     manager,
     grads: Any,
@@ -89,10 +123,10 @@ def allreduce_gradients(
       ``manager.allreduce_many``; the averaging is one jitted psum over the
       'ft' mesh axis riding ICI and the gradients never touch the host.
     * **host path** (``CollectivesTcp`` — groups in separate processes,
-      DCN): device arrays are pulled to host (async per-leaf D2H overlaps
-      the transfers), bucketed into ~25 MB flat buffers, ring-allreduced,
-      and returned as numpy — feed them straight into the jitted optimizer
-      update, XLA transfers them back to device.
+      DCN): a per-bucket pipeline — D2H of bucket k+1 overlaps the TCP
+      ring of bucket k overlaps the H2D of bucket k−1. Averaged leaves
+      come back as device arrays (the H2D already happened), ready for
+      the jitted optimizer update.
 
     Both scale by ``1/num_participants()`` and swallow errors into the
     Manager's latched state.
@@ -112,8 +146,7 @@ def allreduce_gradients(
     # averaged once and re-placed to every holder.
     from torchft_tpu.checkpointing.serialization import _index_desc
 
-    # overlap D2H across leaves before the first blocking np.asarray —
-    # for process-spanning leaves, prefetch each local shard
+    # stage 0: kick off D2H for every leaf/shard before anything blocks
     try:
         for leaf in leaves:
             if not isinstance(leaf, jax.Array):
@@ -126,44 +159,99 @@ def allreduce_gradients(
     except Exception:  # noqa: BLE001 — prefetch is best-effort
         pass
 
-    host: List[np.ndarray] = []
-    rebuild: List[Tuple] = []
-    for leaf in leaves:
+    # item descriptors (metadata only; no blocking transfer yet)
+    items: List[_Item] = []
+    for li, leaf in enumerate(leaves):
         if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-            seen = {}
+            seen: Dict[Tuple, Any] = {}
             for s in leaf.addressable_shards:
                 idx = _index_desc(s.index, leaf.shape)
-                if idx not in seen:
-                    seen[idx] = np.ascontiguousarray(np.asarray(s.data))
-            rebuild.append(("shards", leaf, list(seen.keys())))
-            host.extend(seen.values())
+                if idx not in seen:  # replicated copies average once
+                    seen[idx] = s.data
+            for idx, data in seen.items():
+                items.append(_Item(li, data, data.dtype, data.shape, idx))
         else:
-            rebuild.append(("dense",))
-            host.append(np.ascontiguousarray(np.asarray(leaf)))
+            dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+            shape = getattr(leaf, "shape", None)
+            if shape is None:
+                shape = np.asarray(leaf).shape
+            items.append(_Item(li, leaf, dtype, shape))
 
-    buckets = flatten_buckets(host, bucket_bytes)
-    # one managed op for all buckets (in-place on the numpy buffers):
-    # same bytes, a single SPMD slot instead of per-bucket dispatch
-    manager.allreduce_many([buf for buf, _ in buckets]).wait()
-    averaged = unflatten_buckets(buckets, host)
+    plan = plan_buckets([(it.dtype, it.nbytes) for it in items], bucket_bytes)
 
-    out: List[Any] = []
-    it = iter(averaged)
-    for item, leaf in zip(rebuild, leaves):
-        if item[0] == "dense":
-            out.append(next(it))
-        else:
-            _, template, idxs = item
-            by_idx = {idx: next(it) for idx in idxs}
-            arrays = [
-                jax.device_put(by_idx[_index_desc(index, template.shape)], dev)
-                for dev, index in template.sharding.addressable_devices_indices_map(
-                    template.shape
-                ).items()
-            ]
-            out.append(
-                jax.make_array_from_single_device_arrays(
-                    template.shape, template.sharding, arrays
-                )
+    def _run_bucket(idxs: List[int]):
+        # stage 1 (main thread): materialize this bucket's host buffers —
+        # blocks only on *this* bucket's D2H while earlier buckets are
+        # already riding the ring on the op thread
+        flat = [
+            np.ascontiguousarray(np.asarray(items[i].src)).reshape(-1)
+            for i in idxs
+        ]
+        # the bucket buffer always owns its memory: the ring reduces (and
+        # non-participants zero) in place, which must never write through
+        # a view of the caller's arrays or a read-only XLA host buffer
+        buf = np.concatenate(flat) if len(flat) > 1 else flat[0].copy()
+
+        # stage 2 (op thread): quorum-managed ring allreduce of the bucket
+        fut = manager.allreduce_many([buf])
+
+        # dense jax leaves carry their sharding so stage 3 can start the
+        # averaged piece's H2D without waiting for the whole tree
+        put_shardings = []
+        for i in idxs:
+            it = items[i]
+            s = (
+                getattr(it.src, "sharding", None)
+                if it.index is None and isinstance(it.src, jax.Array)
+                else None
             )
+            put_shardings.append(s)
+        shapes = [items[i].shape for i in idxs]
+
+        def scatter(f):
+            # stage 3 (runs on the op thread as soon as this bucket's ring
+            # finishes, while the next bucket's ring occupies the wire):
+            # slice the averaged buffer and dispatch H2D immediately
+            res = f.value()[0]
+            parts = []
+            off = 0
+            for shp, sharding in zip(shapes, put_shardings):
+                n = int(np.prod(shp, dtype=np.int64))
+                piece = res[off : off + n].reshape(shp)
+                off += n
+                if sharding is not None:
+                    piece = jax.device_put(piece, sharding)
+                parts.append(piece)
+            return parts
+
+        return fut.then(scatter)
+
+    bucket_futs = [(idxs, _run_bucket(idxs)) for idxs in plan]
+
+    # collect averaged pieces per item (in order; waits overlap the tail)
+    item_out: List[np.ndarray] = [None] * len(items)  # type: ignore[list-item]
+    for idxs, fut in bucket_futs:
+        parts = fut.wait()
+        for i, piece in zip(idxs, parts):
+            item_out[i] = piece
+
+    # reassemble leaves
+    out: List[Any] = [None] * len(leaves)
+    shard_acc: Dict[int, Dict[Tuple, np.ndarray]] = {}
+    for it, averaged in zip(items, item_out):
+        if it.index is None:
+            out[it.leaf_pos] = averaged
+        else:
+            shard_acc.setdefault(it.leaf_pos, {})[it.index] = averaged
+    for li, by_idx in shard_acc.items():
+        template = leaves[li]
+        arrays = [
+            jax.device_put(by_idx[_index_desc(index, template.shape)], dev)
+            for dev, index in template.sharding.addressable_devices_indices_map(
+                template.shape
+            ).items()
+        ]
+        out[li] = jax.make_array_from_single_device_arrays(
+            template.shape, template.sharding, arrays
+        )
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -476,11 +476,20 @@ class Manager:
                 for t in tensors:
                     t[...] = 0  # in place: host buffers are bucket views
 
+        # snapshot this epoch's rank→replica map: an in-flight op can fail
+        # AFTER the next quorum has renumbered ranks, and a PeerGoneError
+        # mapped through the new list would accuse an innocent replica
+        ids_snapshot = list(self._participant_ids)
+
         try:
             work = self._collectives.allreduce(tensors, ReduceOp.SUM)
 
             def normalize(fut: Future) -> List[Any]:
-                reduced = fut.value()  # surface exceptions
+                try:
+                    reduced = fut.value()  # surface exceptions
+                except BaseException as e:  # noqa: BLE001 — annotate + rethrow
+                    e._tft_participants = ids_snapshot
+                    raise
                 n = self.num_participants()
                 if device:
                     return _divide_tree(reduced, n)
@@ -509,17 +518,22 @@ class Manager:
         expires passively) and must never block or fail the training
         thread."""
         peer: Optional[int] = None
+        participants = None
         seen = 0
         cause: Optional[BaseException] = e
         while cause is not None and seen < 8:  # unwrap chained causes
+            if participants is None:
+                participants = getattr(cause, "_tft_participants", None)
             peer = getattr(cause, "peer_rank", None)
             if peer is not None:
                 break
             cause = cause.__cause__ or cause.__context__
             seen += 1
-        if peer is None or not (0 <= peer < len(self._participant_ids)):
+        if participants is None:
+            participants = list(self._participant_ids)
+        if peer is None or not (0 <= peer < len(participants)):
             return
-        victim = self._participant_ids[peer]
+        victim = participants[peer]
         if victim in self._evicted:
             return
         self._evicted.add(victim)
