@@ -1,24 +1,27 @@
 """Headline benchmark: fault-tolerant transformer training throughput.
 
 Runs the full FT loop — real C++ lighthouse + manager, quorum per step,
-cross-replica-group gradient averaging per step (the device-path data
-plane), commit vote per step — around the jitted bf16 transformer train
-step on whatever accelerator is attached (TPU under the driver; CPU works
-too). Also measures: a long-context s=4096 variant (XLA fused attention —
-the pallas flash kernel auto-engages only at s>=8192 where fused
-attention's materialized scores stop fitting), and the recovery envelope
-BASELINE.md names as the target: quorum-recovery wall-clock after
-SIGKILLing 1 of 2 replica groups (torchft_tpu/benchmarks/recovery.py).
+commit vote per step — around the jitted bf16 transformer train step on
+whatever accelerator is attached (TPU under the driver; CPU works too).
+The headline is a SINGLE replica group on one chip (median of 3 runs,
+spread reported): the per-step FT control path is fully real; the cross-
+group psum no-ops at world=1, so the real 2-group averaging costs are
+measured by dedicated extras instead of mislabeled into the headline
+(round-2 review weak #1/#2):
+
+* ``cpu_mesh_2group`` — REAL device-path 'ft'-axis psum between two
+  groups on a virtual 8-CPU mesh, relative overhead;
+* ``crossgroup_host_plane`` — two separate OS processes over the TCP
+  ring (serial vs pipelined vs bf16 wire, derived llama2-7b cost);
+* a long-context s=4096 variant, a 647M-param scale variant, and the
+  recovery envelope BASELINE.md names (SIGKILL 1 of 2 groups).
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
 vs_baseline is 1.0 by definition: the reference (Krishn1412/torchft)
 publishes no performance numbers (BASELINE.md), so the measured value IS
-the baseline being established. `extra` carries the secondary metrics:
-MFU, averaging overhead (steps/s with vs without the FT data plane),
-long-context (pallas flash attention) throughput, and the recovery
-envelope.
+the baseline being established.
 """
 
 import json
@@ -143,6 +146,42 @@ def train_bench(cfg, batch, seq, steps, warmup, averaging: bool):
     return steps / elapsed, n_params
 
 
+def _run_json_subprocess(cmd, timeout_s: float, env_extra=None) -> dict:
+    """Run a bench worker; parse the last stdout line as JSON.
+
+    The worker runs in its own session and a timeout kills the whole
+    process group — a wedged grandchild (e.g. a re-exec'd worker holding
+    the inherited stdout pipe) must fail the variant, not hang bench.py
+    in communicate() forever."""
+    import signal
+    import subprocess
+
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        raise
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{cmd[-1]} failed rc={proc.returncode}: {err.decode()[-1500:]}"
+        )
+    return json.loads(out.decode().strip().splitlines()[-1])
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -163,8 +202,23 @@ def main() -> None:
     batch, seq = (8, 1024) if on_tpu else (4, 128)
     steps, warmup = (20, 3) if on_tpu else (5, 1)
 
-    sps, n_params = train_bench(cfg, batch, seq, steps, warmup, averaging=True)
-    sps_noavg, _ = train_bench(cfg, batch, seq, steps, warmup, averaging=False)
+    # 3 runs: the round-2 → round-1 "regression" (17.7 vs 20.0 steps/s)
+    # turned out to be unreported run-to-run variance/host contamination;
+    # the headline is now the median with the spread alongside
+    n_runs = 3 if on_tpu else 1
+    runs = []
+    noavg_runs = []
+    n_params = 0
+    for _ in range(n_runs):  # interleaved: both variants see the same drift
+        r, n_params = train_bench(cfg, batch, seq, steps, warmup, averaging=True)
+        runs.append(r)
+        noavg_runs.append(
+            train_bench(cfg, batch, seq, steps, warmup, averaging=False)[0]
+        )
+    runs.sort()
+    noavg_runs.sort()
+    sps = runs[len(runs) // 2]
+    sps_noavg = noavg_runs[len(noavg_runs) // 2]
     tokens_per_sec = sps * batch * seq
     overhead_pct = (sps_noavg - sps) / sps_noavg * 100.0 if sps_noavg else 0.0
 
@@ -173,12 +227,32 @@ def main() -> None:
     mfu_pct = (sps * flops / peak * 100.0) if peak else None
 
     extra = {
-        "data_plane": "device-path (CollectivesDevice: XLA psum over the "
-        "'ft' mesh axis; grads never leave HBM)",
-        "steps_per_sec_no_averaging": round(sps_noavg, 4),
-        "averaging_overhead_pct": round(overhead_pct, 2),
+        "data_plane": "device-path (CollectivesDevice); SINGLE replica "
+        "group on one chip, so the cross-group psum no-ops at world=1 — "
+        "what IS measured per step: real quorum RPC + commit vote + the "
+        "managed-op machinery + jitted 1/n normalization. Real 2-group "
+        "averaging costs: see cpu_mesh_2group (device path) and "
+        "crossgroup_host_plane (separate processes).",
+        "headline_runs_steps_per_sec": [round(r, 4) for r in runs],
+        "headline_spread_pct": round(
+            (max(runs) - min(runs)) / sps * 100.0, 2
+        ),
+        "steps_per_sec_no_ft_control": round(sps_noavg, 4),
+        "noavg_runs_steps_per_sec": [round(r, 4) for r in noavg_runs],
+        "ft_control_overhead_pct": round(overhead_pct, 2),
         "n_params": n_params,
         "mfu_pct": round(mfu_pct, 2) if mfu_pct is not None else None,
+        "config": {
+            "model": "d512 L8 h8 ff1408 vocab32k bf16",
+            "remat": True,
+            "batch": batch,
+            "seq": seq,
+            "steps": steps,
+            "warmup": warmup,
+            "optimizer": "adamw(3e-4), fused-apply donated buffers",
+            "jax": jax.__version__,
+            "device": getattr(jax.devices()[0], "device_kind", "?"),
+        },
     }
 
     # long-context variant (TPU only): s=4096, XLA fused attention (the
@@ -219,6 +293,44 @@ def main() -> None:
             "mfu_pct": round(big_sps * big_flops / peak * 100.0, 2) if peak else None,
         }
 
+    # REAL 2-group device-path averaging on a virtual 8-CPU mesh (round-2
+    # review weak #1: the single-chip headline can't measure it)
+    try:
+        extra["cpu_mesh_2group"] = _run_json_subprocess(
+            [sys.executable, "-m", "torchft_tpu.benchmarks.cpu_mesh_2group"],
+            timeout_s=900,
+            # pre-set the virtual-mesh env so the worker skips its re-exec
+            # (a grandchild would outlive a group-kill on timeout)
+            env_extra={
+                "_TFT_CPU2G": "1",
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (
+                    os.environ.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count=8"
+                ).strip(),
+            },
+        )
+    except Exception as e:  # noqa: BLE001 — secondary metric, best-effort
+        extra["cpu_mesh_2group"] = {"error": str(e)}
+
+    # cross-PROCESS host data plane (the north-star multi-host topology):
+    # serial vs pipelined vs bf16-wire, with derived llama2-7b cost
+    try:
+        extra["crossgroup_host_plane"] = _run_json_subprocess(
+            [
+                sys.executable,
+                "-m",
+                "torchft_tpu.benchmarks.crossgroup",
+                "--total-mb",
+                "128",
+                "--rounds",
+                "2",
+            ],
+            timeout_s=900,
+        )
+    except Exception as e:  # noqa: BLE001
+        extra["crossgroup_host_plane"] = {"error": str(e)}
+
     # recovery envelope (BASELINE.md driver metric): 2 replica groups in
     # subprocesses on CPU, SIGKILL one, measure blackout + rejoin
     try:
@@ -234,8 +346,10 @@ def main() -> None:
                 "metric": "ft_transformer_train_steps_per_sec_per_chip",
                 "value": round(sps, 4),
                 "unit": f"steps/s (bf16 d512 L8 b{batch} s{seq}; "
-                f"{tokens_per_sec:.0f} tok/s; full quorum+commit+"
-                f"cross-group grad averaging per step)",
+                f"{tokens_per_sec:.0f} tok/s; single replica group, full "
+                f"quorum+commit FT control per step; median of "
+                f"{len(runs)} runs — see extra for 2-group averaging "
+                f"benches)",
                 "vs_baseline": 1.0,
                 "extra": extra,
             }

@@ -49,3 +49,94 @@ def test_restart_exhaustion():
         max_restarts=1,
     )
     assert code == 1
+
+
+# ---------------------------------------------------------------------------
+# Kubernetes artifact (reference torchx.py:11-76 analogue)
+# ---------------------------------------------------------------------------
+
+
+def test_emit_k8s_manifests():
+    yaml = pytest.importorskip("yaml")
+
+    from torchft_tpu.k8s import (
+        COORD_PORT,
+        LIGHTHOUSE_PORT,
+        STORE_PORT,
+        emit_manifests,
+    )
+
+    text = emit_manifests(
+        ["python", "examples/train_hsdp.py"],
+        name="job",
+        image="gcr.io/p/i:v1",
+        num_groups=3,
+        nproc=4,
+        min_replicas=2,
+        max_restarts=5,
+        tpu_accelerator="tpu-v5-lite-podslice",
+        tpu_topology="2x4",
+    )
+    docs = list(yaml.safe_load_all(text))
+    kinds = [(d["kind"], d["metadata"]["name"]) for d in docs]
+    assert ("Deployment", "job-lighthouse") in kinds
+    assert ("Service", "job-lighthouse") in kinds
+    for gid in range(3):
+        assert ("Job", f"job-g{gid}") in kinds
+        assert ("Service", f"job-g{gid}") in kinds
+
+    lh = next(d for d in docs if d["kind"] == "Deployment")
+    lh_args = lh["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--min_replicas" in lh_args and "2" in lh_args  # min_replicas wired
+
+    job = next(
+        d for d in docs if d["kind"] == "Job" and d["metadata"]["name"] == "job-g1"
+    )
+    spec = job["spec"]
+    assert spec["completionMode"] == "Indexed"
+    assert spec["completions"] == 4 and spec["parallelism"] == 4
+    pod = spec["template"]["spec"]
+    c = pod["containers"][0]
+    # the pod command is the k8s-worker bootstrap wrapping the user cmd
+    assert c["command"][:4] == ["python", "-m", "torchft_tpu.launcher", "--k8s-worker"]
+    assert c["command"][-2:] == ["python", "examples/train_hsdp.py"]
+    env = {e["name"]: e for e in c["env"]}
+    assert env["REPLICA_GROUP_ID"]["value"] == "1"
+    assert env["NUM_REPLICA_GROUPS"]["value"] == "3"
+    assert env["WORLD_SIZE"]["value"] == "4"
+    assert env["TORCHFT_LIGHTHOUSE"]["value"] == f"job-lighthouse:{LIGHTHOUSE_PORT}"
+    assert env["TORCHFT_GROUP_HOST0"]["value"] == "job-g1-0.job-g1"
+    assert "job-completion-index" in str(env["RANK"]["valueFrom"])
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"]
+    # headless service exposes store + coordinator ports
+    svc = next(
+        d for d in docs if d["kind"] == "Service" and d["metadata"]["name"] == "job-g1"
+    )
+    ports = {p["name"]: p["port"] for p in svc["spec"]["ports"]}
+    assert ports == {"store": STORE_PORT, "coord": COORD_PORT}
+
+
+def test_k8s_worker_bootstrap_hosts_store(monkeypatch):
+    """Rank 0's bootstrap must host a reachable KV store and point the
+    child at it; a nonzero child exit propagates."""
+    from torchft_tpu.launcher import k8s_worker
+
+    monkeypatch.setenv("RANK", "0")
+    monkeypatch.setenv("WORLD_SIZE", "1")
+    monkeypatch.setenv("TORCHFT_GROUP_HOST0", "localhost")
+    # ephemeral store port: parallel test runs must not fight over the
+    # fixed in-cluster port
+    monkeypatch.setenv("TORCHFT_STORE_PORT", "0")
+
+    child = (
+        "import os, sys\n"
+        "from datetime import timedelta\n"
+        "from torchft_tpu.store import StoreClient\n"
+        "addr = os.environ['TORCHFT_STORE_ADDR']\n"
+        "c = StoreClient(addr, connect_timeout=timedelta(seconds=5))\n"
+        "c.set('k8s', 'ok')\n"
+        "assert c.get('k8s') == b'ok'\n"
+        "c.close()\n"
+        "sys.exit(7)\n"
+    )
+    assert k8s_worker([sys.executable, "-c", child]) == 7

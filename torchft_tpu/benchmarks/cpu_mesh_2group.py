@@ -146,8 +146,14 @@ def _measure(averaging: bool, steps: int, warmup: int) -> float:
 def main() -> None:
     _ensure_cpu_mesh()
     steps, warmup = 5, 2
-    with_avg = _measure(True, steps, warmup)
-    without = _measure(False, steps, warmup)
+    # interleave the variants and keep best-of-2 per variant: on a shared
+    # CPU host the run-to-run noise otherwise dwarfs the psum cost (the
+    # first cut of this bench measured the overhead at -80%)
+    avg_runs, noavg_runs = [], []
+    for _ in range(2):
+        avg_runs.append(_measure(True, steps, warmup))
+        noavg_runs.append(_measure(False, steps, warmup))
+    with_avg, without = max(avg_runs), max(noavg_runs)
     overhead = (without - with_avg) / without * 100.0 if without else 0.0
     print(
         json.dumps(
@@ -156,7 +162,8 @@ def main() -> None:
                 "steps_per_sec_2group_noavg": round(without, 4),
                 "averaging_overhead_pct": round(overhead, 2),
                 "config": "2 groups × dp=4 virtual CPU devices, d256 L4 "
-                "b4 s128 f32, device-path 'ft' psum, sync quorum",
+                "b4 s128 f32, device-path 'ft' psum, sync quorum; "
+                "best-of-2 per variant",
             }
         ),
         flush=True,

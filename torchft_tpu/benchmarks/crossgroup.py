@@ -253,7 +253,8 @@ def main() -> None:
     parser.add_argument("--total-mb", type=float, default=256.0)
     parser.add_argument("--rounds", type=int, default=3)
     args = parser.parse_args()
-    print(json.dumps(measure_crossgroup(args.total_mb, args.rounds), indent=2))
+    # ONE line: callers (bench.py) parse the last stdout line as JSON
+    print(json.dumps(measure_crossgroup(args.total_mb, args.rounds)), flush=True)
 
 
 if __name__ == "__main__":

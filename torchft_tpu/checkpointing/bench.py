@@ -25,6 +25,14 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--transport", choices=["http", "collectives"], default="http"
     )
+    parser.add_argument(
+        "--window",
+        type=int,
+        default=0,
+        help="collectives transport in-flight window override (0 = default "
+        "3; 1 reproduces the round-2 serial send/wait schedule — measured "
+        "13x slower at 1 GB on loopback)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -57,16 +65,21 @@ def main(argv=None) -> None:
         from concurrent.futures import ThreadPoolExecutor
 
         from torchft_tpu.checkpointing.collectives_transport import (
+            _WINDOW,
             CollectivesTransport,
         )
         from torchft_tpu.collectives import CollectivesTcp
         from torchft_tpu.store import StoreServer
 
+        window = args.window if args.window > 0 else _WINDOW
+
         store = StoreServer()
         colls = [CollectivesTcp(timeout=timeout) for _ in range(2)]
         with ThreadPoolExecutor(max_workers=2) as pool:
             list(pool.map(lambda i: colls[i].configure(store.address(), i, 2), range(2)))
-        transports = [CollectivesTransport(c, timeout=timeout) for c in colls]
+        transports = [
+            CollectivesTransport(c, timeout=timeout, window=window) for c in colls
+        ]
         staged = 0.0
         t0 = time.perf_counter()
         with ThreadPoolExecutor(max_workers=2) as pool:

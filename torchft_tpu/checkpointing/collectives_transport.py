@@ -56,9 +56,15 @@ def _data_tag(i: int) -> int:
 
 
 class CollectivesTransport(CheckpointTransport[T], Generic[T]):
-    def __init__(self, collectives: Collectives, timeout: timedelta) -> None:
+    def __init__(
+        self,
+        collectives: Collectives,
+        timeout: timedelta,
+        window: int = _WINDOW,
+    ) -> None:
         self._collectives = collectives
         self._timeout = timeout
+        self._window = max(1, window)
 
     def metadata(self) -> str:
         return "<collectives>"
@@ -77,7 +83,7 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
         self._collectives.send(hdr_arr, dst, tag=_META_TAG).wait(timeout)
         window: Deque = deque()
         for i, buf in enumerate(buffers):
-            while len(window) >= _WINDOW:
+            while len(window) >= self._window:
                 window.popleft().wait(timeout)
             window.append(
                 self._collectives.send(
@@ -126,7 +132,7 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
         buffers: List[np.ndarray] = []
         window: Deque = deque()
         for i, nbytes in enumerate(buffer_sizes(infos)):
-            while len(window) >= _WINDOW:
+            while len(window) >= self._window:
                 window.popleft().wait(timeout)
             buf = np.zeros(nbytes, dtype=np.uint8)
             buffers.append(buf)

@@ -199,6 +199,61 @@ def launch(
     return exit_code
 
 
+def k8s_worker(cmd: Sequence[str]) -> int:
+    """In-cluster per-pod bootstrap (the pod command the --emit-k8s
+    manifests render). Completes the env contract a scheduler can't:
+
+    * pod index 0 hosts the replica group's KV store (and names itself
+      the jax coordinator for multi-host groups);
+    * every pod resolves both through the index-0 pod's stable DNS
+      (``TORCHFT_GROUP_HOST0``, set by the manifest) and execs the
+      training command with ``TORCHFT_STORE_ADDR`` /
+      ``TORCHFT_JAX_COORDINATOR`` filled in.
+    """
+    import signal
+
+    from torchft_tpu.k8s import COORD_PORT, STORE_PORT
+
+    rank = int(os.environ.get("RANK", "0") or "0")
+    world = int(os.environ.get("WORLD_SIZE", "1"))
+    host0 = os.environ.get("TORCHFT_GROUP_HOST0", "localhost")
+    # TORCHFT_STORE_PORT=0 → ephemeral (tests / single-pod runs only:
+    # peer pods can't guess an ephemeral port)
+    port = int(os.environ.get("TORCHFT_STORE_PORT", STORE_PORT))
+
+    env = dict(os.environ)
+    env["RANK"] = str(rank)
+    store = None
+    if rank == 0:
+        from torchft_tpu.store import StoreServer
+
+        store = StoreServer(bind=f"[::]:{port}")
+        port = store.port
+    env["TORCHFT_STORE_ADDR"] = f"{host0}:{port}"
+    if world > 1:
+        env["TORCHFT_JAX_COORDINATOR"] = f"{host0}:{COORD_PORT}"
+
+    proc = subprocess.Popen(list(cmd), env=env)
+
+    # this bootstrap is container PID 1: forward termination signals so the
+    # trainer gets its graceful-shutdown window (checkpoint flush, clean
+    # quorum leave) before kubelet's grace period expires
+    def _forward(signum, frame):  # noqa: ARG001
+        try:
+            proc.send_signal(signum)
+        except OSError:
+            pass
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _forward)
+
+    try:
+        return proc.wait()
+    finally:
+        if store is not None:
+            store.shutdown()
+
+
 def main(argv: Optional[Sequence[str]] = None) -> None:
     parser = argparse.ArgumentParser(
         description="Launch N fault-tolerant replica groups of a training script"
@@ -208,12 +263,55 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     parser.add_argument("--lighthouse", default=None, help="existing host:port")
     parser.add_argument("--max-restarts", type=int, default=10)
     parser.add_argument("--min-replicas", type=int, default=None)
+    parser.add_argument(
+        "--emit-k8s",
+        action="store_true",
+        help="print Kubernetes manifests for this topology instead of "
+        "launching locally (the TorchX-component analogue, "
+        "reference torchx.py:11-76)",
+    )
+    parser.add_argument(
+        "--k8s-worker",
+        action="store_true",
+        help="internal: in-cluster per-pod bootstrap (store/coordinator "
+        "hosting + env completion); used by the emitted manifests",
+    )
+    parser.add_argument("--image", default="IMAGE", help="--emit-k8s: container image")
+    parser.add_argument("--name", default="torchft", help="--emit-k8s: resource prefix")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument(
+        "--tpu-accelerator", default=None, help="--emit-k8s: GKE nodeSelector"
+    )
+    parser.add_argument(
+        "--tpu-topology", default=None, help="--emit-k8s: GKE TPU topology"
+    )
     parser.add_argument("cmd", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
     if not cmd:
         parser.error("no command given (use: launcher [opts] -- cmd ...)")
     logging.basicConfig(level=logging.INFO)
+    if args.emit_k8s:
+        from torchft_tpu.k8s import emit_manifests
+
+        print(
+            emit_manifests(
+                cmd,
+                name=args.name,
+                image=args.image,
+                num_groups=args.groups,
+                nproc=args.nproc,
+                min_replicas=args.min_replicas,
+                max_restarts=args.max_restarts,
+                namespace=args.namespace,
+                tpu_accelerator=args.tpu_accelerator,
+                tpu_topology=args.tpu_topology,
+            ),
+            end="",
+        )
+        return
+    if args.k8s_worker:
+        sys.exit(k8s_worker(cmd))
     sys.exit(
         launch(
             cmd,
