@@ -78,25 +78,7 @@ def ensure_corpus(path: str) -> bytes:
         return f.read()
 
 
-def batch_indices(sampler: DistributedSampler, step: int, batch: int):
-    """This group's sample indices for committed step ``step``.
-
-    Purely a function of the committed step count: position
-    ``step*batch`` into the group's per-epoch partition stream, crossing
-    epoch boundaries as needed. Restart/heal correctness falls out — the
-    healed/restored step IS the dataloader position."""
-    part_len = len(sampler)
-    ids = []
-    pos = step * batch
-    while len(ids) < batch:
-        epoch, off = divmod(pos, part_len)
-        sampler.load_state_dict({"epoch": epoch, "position": off})
-        for idx in sampler:
-            ids.append(idx)
-            pos += 1
-            if len(ids) == batch:
-                break
-    return np.asarray(ids, dtype=np.int64)
+from torchft_tpu.data import step_indices as batch_indices  # noqa: E402
 
 
 def main() -> None:

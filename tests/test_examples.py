@@ -87,3 +87,19 @@ def test_hsdp_example_two_groups():
     )
     sums = _checksums(logs)
     assert len(set(sums)) == 1, sums
+
+
+def test_resnet_cifar_two_groups(tmp_path):
+    """BASELINE.md config: "ResNet-18 CIFAR-10 DDP" — conv model family
+    through the full FT loop, bit-identical params across groups."""
+    logs = _run_groups(
+        "train_cifar.py",
+        num_groups=2,
+        extra_env={
+            "STEPS": "3",
+            "BATCH": "8",
+            "DATA_PATH": str(tmp_path / "cifar.npz"),
+        },
+    )
+    sums = _checksums(logs)
+    assert len(set(sums)) == 1, sums

@@ -18,7 +18,34 @@ from typing import Iterator, Optional
 
 import numpy as np
 
-__all__ = ["DistributedSampler"]
+__all__ = ["DistributedSampler", "step_indices"]
+
+
+def step_indices(sampler: "DistributedSampler", step: int, batch: int) -> np.ndarray:
+    """This group's sample indices for committed step ``step``.
+
+    Derives the sampler's (epoch, position) purely from the committed step
+    count — the one clock every replica group provably agrees on — so a
+    killed/healed/disk-resumed group picks up exactly where its last
+    committed step left off (no sample double-trained, none skipped) and
+    groups can never desync epochs (partitions stay disjoint). Crosses
+    epoch boundaries as needed; a failed commit retries the same batch
+    because the step didn't advance. The reference leans on torchdata's
+    StatefulDataLoader position checkpointing for this
+    (train_ddp.py:57-61); deriving from the committed step is strictly
+    stronger — correct even when the position snapshot is stale."""
+    part_len = len(sampler)
+    ids = []
+    pos = step * batch
+    while len(ids) < batch:
+        epoch, off = divmod(pos, part_len)
+        sampler.load_state_dict({"epoch": epoch, "position": off})
+        for idx in sampler:
+            ids.append(idx)
+            pos += 1
+            if len(ids) == batch:
+                break
+    return np.asarray(ids, dtype=np.int64)
 
 
 class DistributedSampler:
