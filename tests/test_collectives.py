@@ -27,11 +27,13 @@ def store():
     s.shutdown()
 
 
-def _run_world(store, world, fn, prefix="test"):
+def _run_world(store, world, fn, prefix="test", **coll_kwargs):
     """Run fn(coll, rank) on `world` configured TCP collectives, one thread
-    per rank (the reference's in-process multi-rank harness)."""
+    per rank (the reference's in-process multi-rank harness). Extra kwargs
+    go to the CollectivesTcp constructors (e.g. wire_dtype)."""
+    coll_kwargs.setdefault("timeout", timedelta(seconds=10))
     colls = [
-        CollectivesTcp(timeout=timedelta(seconds=10), hostname="localhost")
+        CollectivesTcp(hostname="localhost", **coll_kwargs)
         for _ in range(world)
     ]
 
@@ -251,36 +253,13 @@ class TestWirePipeline:
     (process_group.py:431-447)."""
 
     def test_bf16_wire_allreduce(self, store):
-        def fn_factory(wire):
-            def fn(c, rank):
-                arr = np.linspace(
-                    -3.0, 3.0, 4099, dtype=np.float32
-                ) * (rank + 1)
-                return c.allreduce([arr], ReduceOp.AVG).wait(
-                    timedelta(seconds=20)
-                )[0]
+        def fn(c, rank):
+            arr = np.linspace(-3.0, 3.0, 4099, dtype=np.float32) * (rank + 1)
+            return c.allreduce([arr], ReduceOp.AVG).wait(
+                timedelta(seconds=20)
+            )[0]
 
-            return fn
-
-        colls = [
-            CollectivesTcp(
-                timeout=timedelta(seconds=10),
-                hostname="localhost",
-                wire_dtype="bfloat16",
-            )
-            for _ in range(3)
-        ]
-
-        def start(rank):
-            colls[rank].configure(f"{store.address()}/bf16w", rank, 3)
-            try:
-                return fn_factory("bfloat16")(colls[rank], rank)
-            finally:
-                colls[rank].shutdown()
-
-        with ThreadPoolExecutor(max_workers=3) as ex:
-            outs = list(ex.map(start, range(3)))
-
+        outs = _run_world(store, 3, fn, prefix="bf16w", wire_dtype="bfloat16")
         expect = np.linspace(-3.0, 3.0, 4099, dtype=np.float32) * 2.0
         for out in outs:
             assert out.dtype == np.float32
@@ -341,6 +320,65 @@ class TestWirePipeline:
         outs = _run_world(store, 2, fn, prefix="win")
         for i, buf in enumerate(outs[1]):
             np.testing.assert_allclose(buf, float(i))
+
+    def test_concurrent_streams_soak(self, store):
+        # 30 rounds of simultaneous ring allreduce + bidirectional windowed
+        # p2p on the same socket pair: the stash must route every frame to
+        # its op with no desync, leak, or value corruption
+        rounds, nbuf = 30, 4
+
+        def fn(c, rank):
+            peer = 1 - rank
+            for r in range(rounds):
+                ring = np.full(1024, float(rank + 1 + r), dtype=np.float32)
+                ar = c.allreduce([ring], ReduceOp.SUM)
+                sends = [
+                    c.send(
+                        np.full(256, float(r * nbuf + i), dtype=np.float32),
+                        dst=peer,
+                        tag=(rank << 12) | (r * nbuf + i) & 0xFFF,
+                    )
+                    for i in range(nbuf)
+                ]
+                bufs = [np.zeros(256, dtype=np.float32) for _ in range(nbuf)]
+                recvs = [
+                    c.recv(
+                        bufs[i],
+                        src=peer,
+                        tag=(peer << 12) | (r * nbuf + i) & 0xFFF,
+                    )
+                    for i in range(nbuf)
+                ]
+                ar.wait(timedelta(seconds=30))
+                for w in sends + recvs:
+                    w.wait(timedelta(seconds=30))
+                np.testing.assert_array_equal(
+                    ring, float((1 + r) + (2 + r)), err_msg=f"{rank}/{r}"
+                )
+                for i, buf in enumerate(bufs):
+                    np.testing.assert_array_equal(
+                        buf, float(r * nbuf + i), err_msg=f"{rank}/{r}/{i}"
+                    )
+            # stash drained: nothing parked once all ops completed
+            for p in c._peers.values():
+                assert p.stash_bytes == 0, p.stash
+            return True
+
+        assert all(_run_world(store, 2, fn, prefix="soak"))
+
+    def test_bf16_wire_world4_uneven(self, store):
+        # 4-rank ring with chunk sizes that don't divide evenly, compressed
+        def fn(c, rank):
+            arr = np.full(10007, float(rank + 1), dtype=np.float32)
+            return c.allreduce([arr], ReduceOp.SUM).wait(
+                timedelta(seconds=30)
+            )[0]
+
+        outs = _run_world(
+            store, 4, fn, prefix="bf16w4", wire_dtype="bfloat16"
+        )
+        for out in outs:
+            np.testing.assert_allclose(out, 10.0, rtol=2e-2)
 
     def test_p2p_overlaps_ring_traffic(self, store):
         # a checkpoint-style p2p transfer issued while ring allreduces run

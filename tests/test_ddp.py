@@ -1,0 +1,70 @@
+"""DDP bucketing / pipeline unit tests (torchft/ddp.py:32-71 analogue;
+the per-bucket schedule is what the round-3 host pipeline rides)."""
+
+import numpy as np
+import pytest
+
+from torchft_tpu.ddp import flatten_buckets, plan_buckets, unflatten_buckets
+
+
+def test_plan_respects_bucket_bytes_and_dtype():
+    meta = [
+        (np.dtype(np.float32), 60),
+        (np.dtype(np.float32), 60),   # fits with first under 128
+        (np.dtype(np.float32), 60),   # overflows -> new bucket
+        (np.dtype(np.float16), 10),   # dtype change -> new bucket
+        (np.dtype(np.float16), 10),
+    ]
+    plan = plan_buckets(meta, bucket_bytes=128)
+    assert plan == [[0, 1], [2], [3, 4]]
+
+
+def test_plan_empty_and_oversized():
+    assert plan_buckets([], bucket_bytes=128) == []
+    # a single leaf larger than the bucket still gets its own bucket
+    assert plan_buckets([(np.dtype(np.float32), 10**9)], 128) == [[0]]
+
+
+def test_flatten_unflatten_roundtrip():
+    rng = np.random.default_rng(0)
+    leaves = [
+        rng.standard_normal(13).astype(np.float32),
+        rng.standard_normal((3, 5)).astype(np.float32),
+        rng.standard_normal(7).astype(np.float16),
+        np.float32(rng.standard_normal()).reshape(()),  # scalar leaf
+    ]
+    buckets = flatten_buckets(leaves, bucket_bytes=64)
+    # every element lands in exactly one bucket
+    total = sum(buf.size for buf, _ in buckets)
+    assert total == sum(l.size for l in leaves)
+    out = unflatten_buckets(buckets, leaves)
+    for a, b in zip(leaves, out):
+        np.testing.assert_array_equal(a, np.asarray(b))
+        assert b.shape == a.shape and b.dtype == a.dtype
+
+
+def test_pipeline_issues_one_managed_op_per_bucket():
+    """The host path must submit buckets as separate managed ops (that is
+    the pipelining) and reassemble exact averages."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.ddp import allreduce_gradients
+    from torchft_tpu.futures import Future
+
+    calls = []
+
+    class ManagerStub:
+        def device_data_plane(self):
+            return False
+
+        def allreduce_many(self, tensors):
+            calls.append([t.copy() for t in tensors])
+            for t in tensors:
+                np.divide(t, 1.0, out=t)  # identity "average", world 1
+            return Future.completed(tensors)
+
+    grads = {f"g{i}": jnp.full((16,), float(i)) for i in range(5)}
+    out = allreduce_gradients(ManagerStub(), grads, bucket_bytes=64)
+    assert len(calls) == 5  # one op per bucket at 64B buckets
+    for i in range(5):
+        np.testing.assert_allclose(np.asarray(out[f"g{i}"]), float(i))
