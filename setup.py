@@ -1,0 +1,34 @@
+"""Build hook: compile the C++ coordination core into the wheel.
+
+The reference builds its Rust core with maturin (pyproject.toml there);
+the TPU-native equivalent is a plain ``make -C native`` producing
+``torchft_tpu/_native/libtftcore.so``. In-checkout use never needs this —
+the library builds on first import (torchft_tpu/_native/__init__.py) —
+but a wheel must ship the compiled artifact."""
+
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        native = os.path.join(HERE, "native")
+        lib = os.path.join(HERE, "torchft_tpu", "_native", "libtftcore.so")
+        if os.path.isdir(native):
+            subprocess.run(["make", "-C", native], check=True)
+        if not os.path.exists(lib):
+            # never ship a wheel that can neither load nor rebuild the core
+            raise RuntimeError(
+                "native/ sources missing and libtftcore.so not prebuilt; "
+                "build from a full checkout or sdist (MANIFEST.in grafts "
+                "native/)"
+            )
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})

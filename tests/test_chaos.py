@@ -1,0 +1,105 @@
+"""Chaos soak: the same replica group SIGKILLed and restarted repeatedly
+mid-training; the cohort must keep making progress and end bit-identical.
+
+This is the real-subprocess escalation of the reference's torchelastic
+restart emulation (manager_integ_test.py attempts=3, in-thread): three
+full process kills, disk resume + live heal each time, no step skipped or
+double-trained (trace-verified like tests/test_data_example.py)."""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from torchft_tpu.coordination import LighthouseServer
+
+_EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+STEPS = 36
+BATCH = 8
+KILLS = 3
+
+
+def _spawn(gid, lighthouse_addr, tmp):
+    env = dict(os.environ)
+    env.update(
+        REPLICA_GROUP_ID=str(gid),
+        NUM_REPLICA_GROUPS="2",
+        STEPS=str(STEPS),
+        BATCH=str(BATCH),
+        DATA_PATH=os.path.join(tmp, "corpus.bin"),
+        TRACE_PATH=os.path.join(tmp, f"trace{gid}.jsonl"),
+        CKPT_DIR=os.path.join(tmp, "ckpt"),
+        CKPT_EVERY="2",
+        TORCHFT_LIGHTHOUSE=lighthouse_addr,
+        JAX_PLATFORMS="cpu",
+    )
+    return subprocess.Popen(
+        [sys.executable, os.path.join(_EXAMPLES, "train_bytes.py")],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def _trace_steps(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line)["step"] for line in f if line.strip()]
+
+
+def test_repeated_kill_restart_converges(tmp_path):
+    tmp = str(tmp_path)
+    rng = np.random.default_rng(0)
+    with open(os.path.join(tmp, "corpus.bin"), "wb") as f:
+        f.write(rng.integers(0, 256, 4001, dtype=np.uint8).tobytes())
+
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+    addr = lighthouse.address().split("//", 1)[-1]
+    procs = {0: _spawn(0, addr, tmp), 1: _spawn(1, addr, tmp)}
+    victim_trace = os.path.join(tmp, "trace1.jsonl")
+    try:
+        for round_i in range(KILLS):
+            # wait until the victim has committed a few more steps
+            target = len(_trace_steps(victim_trace)) + 3
+            deadline = time.time() + 240
+            while len(_trace_steps(victim_trace)) < target:
+                if procs[1].poll() is not None or procs[0].poll() is not None:
+                    break  # someone finished early (tiny run): stop killing
+                assert time.time() < deadline, f"no progress in round {round_i}"
+                time.sleep(0.5)
+            if procs[1].poll() is not None:
+                break
+            os.kill(procs[1].pid, signal.SIGKILL)
+            procs[1].wait()
+            procs[1] = _spawn(1, addr, tmp)
+
+        outs = {}
+        for g in (0, 1):
+            out, _ = procs[g].communicate(timeout=300)
+            assert procs[g].returncode == 0, out.decode()[-2000:]
+            outs[g] = out.decode()
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        lighthouse.shutdown()
+
+    sums = [
+        re.search(r"param_checksum=(-?\d+\.\d+)", outs[g]).group(1)
+        for g in (0, 1)
+    ]
+    assert sums[0] == sums[1], sums
+
+    # the survivor committed every step exactly once; the victim never
+    # double-trained (steps strictly increasing across all restarts)
+    g0 = _trace_steps(os.path.join(tmp, "trace0.jsonl"))
+    assert g0 == sorted(set(g0)) and set(g0) == set(range(STEPS))
+    g1 = _trace_steps(victim_trace)
+    assert g1 == sorted(set(g1)), "victim double-trained a step"

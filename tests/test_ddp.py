@@ -68,3 +68,8 @@ def test_pipeline_issues_one_managed_op_per_bucket():
     assert len(calls) == 5  # one op per bucket at 64B buckets
     for i in range(5):
         np.testing.assert_allclose(np.asarray(out[f"g{i}"]), float(i))
+
+
+# The mid-pipeline data-plane-death path (error latch + default-resolving
+# futures + commit veto) runs against a REAL Manager in
+# tests/test_manager.py::test_pipelined_averaging_latches_midway_error.

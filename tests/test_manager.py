@@ -297,3 +297,42 @@ def test_quorum_timeout_propagates(harness):
     m.start_quorum(timeout=timedelta(seconds=7))
     m.wait_quorum()
     assert h.client._quorum.call_args.kwargs["timeout"] == timedelta(seconds=7)
+
+
+def test_pipelined_averaging_latches_midway_error(harness):
+    """Data plane dies at bucket 2 of a pipelined host-path averaging run:
+    the REAL Manager must latch the error, short-circuit the remaining
+    bucket ops, still hand back a structurally complete tree, and veto the
+    commit (manager.py wrap_future/error-latch semantics)."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.collectives import PeerGoneError, ReduceOp
+    from torchft_tpu.ddp import allreduce_gradients
+
+    h = harness()
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(max_rank=1)
+    m.start_quorum()
+
+    calls = {"n": 0}
+    real_allreduce = h.collectives.allreduce
+
+    def flaky(arrays, op=ReduceOp.SUM):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise PeerGoneError(0, "peer died mid-bucket")
+        return real_allreduce(arrays, op)
+
+    h.collectives.allreduce = flaky
+
+    grads = {f"g{i}": jnp.full((16,), float(i)) for i in range(4)}
+    out = allreduce_gradients(m, grads, bucket_bytes=64)
+
+    assert m.errored() is not None  # latched
+    assert calls["n"] == 2  # buckets after the failure never hit the wire
+    assert set(out) == set(grads)
+    for i in range(4):
+        assert np.asarray(out[f"g{i}"]).shape == (16,)
+
+    h.client.should_commit.return_value = False
+    assert m.should_commit() is False

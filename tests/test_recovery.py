@@ -29,3 +29,20 @@ def test_recovery_envelope():
     # the envelope in step units: the survivor must keep committing —
     # after the blackout it may not silently skip further steps
     assert r.steady_step_s > 0
+
+
+def test_recovery_1of4_north_star_shape():
+    """BASELINE north star: survive killing 1-of-4 replica groups. The
+    three survivors must keep committing through the blackout and the
+    victim must rejoin and commit."""
+    r = measure_recovery(
+        total_steps=25,
+        kill_at_step=6,
+        step_sleep=0.05,
+        op_timeout=1.0,
+        heartbeat_timeout_ms=1000,
+        timeout_s=120.0,
+        num_groups=4,
+    )
+    assert r.survivor_blackout_s < 6.0, r
+    assert r.rejoin_to_commit_s < 20.0, r

@@ -144,7 +144,9 @@ class RecoveryResult:
         }
 
 
-def _spawn(gid: int, env_extra: Dict[str, str]) -> subprocess.Popen:
+def _spawn(
+    gid: int, env_extra: Dict[str, str], num_groups: int = 2
+) -> subprocess.Popen:
     from torchft_tpu.store import StoreServer
 
     store = StoreServer()
@@ -161,7 +163,7 @@ def _spawn(gid: int, env_extra: Dict[str, str]) -> subprocess.Popen:
     env.update(
         TORCHFT_STORE_ADDR=store.address(),
         REPLICA_GROUP_ID=str(gid),
-        NUM_REPLICA_GROUPS="2",
+        NUM_REPLICA_GROUPS=str(num_groups),
         RANK="0",
         WORLD_SIZE="1",
         # keep children off any accelerator the parent owns
@@ -210,12 +212,16 @@ def measure_recovery(
     op_timeout: float = 1.0,
     heartbeat_timeout_ms: int = 1000,
     timeout_s: float = 120.0,
+    num_groups: int = 2,
 ) -> RecoveryResult:
-    """Run the 2-group kill/heal scenario and measure the envelope."""
+    """Kill 1 of ``num_groups`` replica groups and measure the envelope
+    (``num_groups=4`` is the BASELINE north-star shape: survive killing
+    1-of-4 and re-quorum in < 1 step)."""
     from torchft_tpu.coordination import LighthouseServer
 
+    victim_gid = num_groups - 1
     tmp = tempfile.mkdtemp(prefix="tft_recovery_")
-    logs = [os.path.join(tmp, f"g{g}.jsonl") for g in range(2)]
+    logs = [os.path.join(tmp, f"g{g}.jsonl") for g in range(num_groups)]
     lighthouse = LighthouseServer(
         bind="[::]:0",
         min_replicas=1,
@@ -229,28 +235,33 @@ def measure_recovery(
         "TORCHFT_BENCH_STEP_SLEEP": str(step_sleep),
         "TORCHFT_BENCH_OP_TIMEOUT": str(op_timeout),
     }
-    procs: List[Optional[subprocess.Popen]] = [None, None]
+    procs: List[Optional[subprocess.Popen]] = [None] * num_groups
     try:
-        for g in range(2):
-            procs[g] = _spawn(g, {**common, "TORCHFT_EVENT_LOG": logs[g]})
+        for g in range(num_groups):
+            procs[g] = _spawn(
+                g, {**common, "TORCHFT_EVENT_LOG": logs[g]}, num_groups
+            )
 
-        # let both groups reach the kill step
+        # let the victim reach the kill step
         _wait_for(
-            logs[1],
+            logs[victim_gid],
             lambda e: e["event"] == "commit" and e["step"] >= kill_at_step,
             timeout_s,
             procs=[p for p in procs if p],
         )
-        victim = procs[1]
+        victim = procs[victim_gid]
         t_kill = time.time()
         victim.send_signal(signal.SIGKILL)
         victim.wait()
         victim._torchft_store.shutdown()
 
-        # respawn group 1 fresh (the launcher's restart, done by hand so the
-        # respawn time is known exactly)
+        # respawn the victim fresh (the launcher's restart, done by hand so
+        # the respawn time is known exactly)
         t_respawn = time.time()
-        procs[1] = _spawn(1, {**common, "TORCHFT_EVENT_LOG": logs[1]})
+        procs[victim_gid] = _spawn(
+            victim_gid, {**common, "TORCHFT_EVENT_LOG": logs[victim_gid]},
+            num_groups,
+        )
 
         # survivor's first commit after the kill
         post = _wait_for(
@@ -261,14 +272,18 @@ def measure_recovery(
         )
         # rejoiner's first commit after respawn
         rejoin = _wait_for(
-            logs[1],
+            logs[victim_gid],
             lambda e: e["event"] == "commit" and e["t"] > t_respawn,
             timeout_s,
             procs=[p for p in procs if p],
         )
 
-        for p in procs:
-            p.wait(timeout=timeout_s)
+        for g, p in enumerate(procs):
+            rc = p.wait(timeout=timeout_s)
+            if rc != 0:
+                # a survivor crashing after the measured commits would
+                # otherwise go unnoticed and falsify the envelope
+                raise RuntimeError(f"group {g} exited rc={rc}")
 
         g0 = [e for e in _read_events(logs[0]) if e["event"] == "commit"]
         pre = [e for e in g0 if e["t"] <= t_kill]

@@ -491,6 +491,8 @@ class Manager:
                     e._tft_participants = ids_snapshot
                     raise
                 n = self.num_participants()
+                if n <= 1:
+                    return reduced  # dividing by 1 would only cost a kernel
                 if device:
                     return _divide_tree(reduced, n)
                 for t in reduced:
