@@ -79,3 +79,41 @@ def test_bad_attention_impl_rejected():
 
     with pytest.raises(ValueError, match="attention_impl"):
         _use_flash(TransformerConfig(attention_impl="xla"), 4096)
+
+
+def test_use_flash_auto_threshold(monkeypatch):
+    """The auto rule (the 46x fix): flash only past the per-chip
+    scores-memory ceiling; per-chip = global / (dp·fsdp batch shards,
+    tp head shards)."""
+    from unittest.mock import patch
+
+    import jax.numpy as jnp
+
+    from torchft_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(attention_impl="auto", n_heads=8, dtype=jnp.bfloat16)
+
+    class FakeMesh:
+        def __init__(self, **shape):
+            self.shape = shape
+
+    with patch.object(T.jax, "default_backend", return_value="tpu"):
+        # b1 h8 s8192: 4 * 8 * 8192^2 = 2.1 GB < 4 GB -> plain (the fix)
+        assert not T._use_flash(cfg, 8192, 1)
+        # b1 h8 s32768: 34 GB -> flash (the memory-ceiling role)
+        assert T._use_flash(cfg, 32768, 1)
+        # global b8 would cross the ceiling, but dp=4 shards it 4-way:
+        # per-chip 4.3 GB... / 4 = 1.07... scaled: 4*2*8*8192^2 = 4.3 GB
+        # per chip at dp=4 -> just over; at dp=8 -> under
+        assert not T._use_flash(cfg, 8192, 8, FakeMesh(dp=8))
+        assert T._use_flash(cfg, 8192, 32, FakeMesh(dp=2))
+        # tp shards heads
+        assert not T._use_flash(cfg, 16384, 1, FakeMesh(tp=8))
+        # threshold env override
+        monkeypatch.setenv("TORCHFT_TPU_FLASH_SCORES_GB", "0.5")
+        assert T._use_flash(cfg, 8192, 1)
+        monkeypatch.setenv("TORCHFT_TPU_FLASH_SCORES_GB", "not-a-number")
+        assert not T._use_flash(cfg, 8192, 1)  # malformed -> default 4 GB
+    # non-tpu backend never chooses the pallas kernel
+    with patch.object(T.jax, "default_backend", return_value="cpu"):
+        assert not T._use_flash(cfg, 32768, 1)

@@ -179,7 +179,33 @@ def _ffn_moe(lp: Dict[str, Any], x: jnp.ndarray, cfg: TransformerConfig) -> jnp.
     return out.reshape(b, s, d)
 
 
-def _use_flash(cfg: TransformerConfig, seq_len: int) -> bool:
+def _flash_threshold_bytes() -> float:
+    """Scores-memory ceiling above which auto engages the pallas kernel.
+
+    When the materialized [B,H,S,S] scores exceed this, XLA's plain
+    attention stops fitting HBM and the pallas kernel's O(S·block) memory
+    becomes the only option. Below it, plain is strictly faster — a
+    controlled plain-vs-flash comparison measured 46x at b1 h8 s8192 on
+    v5e (the round-2 "flash at s>=8192" rule was costing auto users
+    exactly that). Override via TORCHFT_TPU_FLASH_SCORES_GB for chips
+    with a different HBM budget."""
+    import os
+
+    raw = os.environ.get("TORCHFT_TPU_FLASH_SCORES_GB", "4")
+    try:
+        return float(raw) * 1e9
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ignoring malformed TORCHFT_TPU_FLASH_SCORES_GB=%r; using 4", raw
+        )
+        return 4e9
+
+
+def _use_flash(
+    cfg: TransformerConfig, seq_len: int, batch: int = 1, mesh=None
+) -> bool:
     if cfg.attention_impl == "plain":
         return False
     if cfg.attention_impl == "flash":
@@ -188,14 +214,26 @@ def _use_flash(cfg: TransformerConfig, seq_len: int) -> bool:
         raise ValueError(
             f"attention_impl must be 'auto'|'plain'|'flash', got {cfg.attention_impl!r}"
         )
-    # auto: the pallas kernel's O(S·block) memory is what makes very long
-    # sequences fit at all; below that XLA's fused attention is faster
-    # (measured on v5e: XLA fused ~10x the pallas kernel's throughput at
-    # S=4096 — jax's own library flash kernel measures the same, so the
-    # crossover is where the materialized [S,S] scores stop fitting HBM)
+    # auto: engage the pallas kernel only when plain attention's scores
+    # would blow PER-CHIP HBM — it is the memory-ceiling path, never the
+    # speed path. The estimate divides the global shapes by the mesh's
+    # batch (dp·fsdp) and head (tp) factors, and uses 4 bytes/element:
+    # plain attention's softmax runs in f32 whatever the compute dtype.
+    itemsize = max(jnp.dtype(cfg.dtype).itemsize, 4)
+    batch_shards = heads_shards = 1
+    if mesh is not None:
+        batch_shards = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        heads_shards = mesh.shape.get("tp", 1)
+    scores_bytes = (
+        float(itemsize)
+        * max(1, batch // batch_shards)
+        * max(1, cfg.n_heads // heads_shards)
+        * seq_len
+        * seq_len
+    )
     return (
         jax.default_backend() == "tpu"
-        and seq_len >= 8192
+        and scores_bytes > _flash_threshold_bytes()
         and seq_len % 128 == 0
     )
 
@@ -246,7 +284,7 @@ def _make_layer_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
             att = ring_attention_local(q, k, v, sp_size, causal=True)
         elif sp_size > 1:
             att = ring_attention(q, k, v, mesh, causal=True)
-        elif _use_flash(cfg, s):
+        elif _use_flash(cfg, s, b, mesh):
             # flash needs its own (full) manual region, which can't nest
             # inside the pipeline's partial-manual shard_map (Shardy rejects
             # nested manual regions) — pp>1 long-context should shard the

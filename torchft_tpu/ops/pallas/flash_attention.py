@@ -11,9 +11,22 @@ sweep (TPU grids execute sequentially per core).
 Causal scheduling masks the diagonal blocks and skips compute above the
 diagonal via ``pl.when``.
 
-All matmuls request ``preferred_element_type=float32`` (MXU accumulates in
-f32). On CPU the kernels run under ``interpret=True`` so unit tests check
-numerics against ``ops.attention``.
+Matmuls keep their storage dtype (bf16) into the MXU and request
+``preferred_element_type=float32`` (f32 accumulate). On CPU the kernels
+run under ``interpret=True`` so unit tests check numerics against
+``ops.attention``.
+
+Role: this kernel is the MEMORY-CEILING path — it makes sequences whose
+[S,S] scores can't fit HBM trainable at all (32k tokens on one v5e chip).
+It is not the speed path: at d=64 each 128×128 block is ~2 microscopic
+matmuls, so the grid is DMA/sequencing-latency-bound and XLA's fused
+attention is an order of magnitude faster wherever it fits (measured 19x
+fwd at s=8192 on v5e). The standard remedies — larger blocks, grouping
+heads per grid step — are rejected by this environment's Mosaic compiler
+(remote-compile crashes on any non-(1,128,128) block structure), so the
+crossover is handled in policy instead: models/transformer.py
+``_use_flash`` engages this kernel only above the scores-memory
+threshold.
 """
 
 from __future__ import annotations
@@ -69,8 +82,10 @@ def _fwd_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        s = _dot(q, k_ref[0].astype(jnp.float32), ((1,), (1,))) * scale
+        # inputs keep their storage dtype (bf16): the MXU takes bf16
+        # operands at full rate and accumulates f32 via
+        # preferred_element_type — upcasting first costs an extra VPU pass
+        s = _dot(q_ref[0], k_ref[0], ((1,), (1,))) * scale
         if causal:
             q_pos = i * bq + _iota(bq)
             k_pos = j * bk + _iota(bk)
@@ -81,7 +96,7 @@ def _fwd_kernel(
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m_prev - m_new)
         acc_ref[...] = acc_ref[...] * corr[:, None] + _dot(
-            p, v_ref[0].astype(jnp.float32), ((1,), (0,))
+            p.astype(v_ref.dtype), v_ref[0], ((1,), (0,))
         )
         l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
         m_ref[:, 0] = m_new
@@ -142,20 +157,17 @@ def _dq_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0, :]
         delta = delta_ref[0, 0, :]
-        k_blk = k_ref[0].astype(jnp.float32)
-        s = _dot(q, k_blk, ((1,), (1,))) * scale
+        s = _dot(q_ref[0], k_ref[0], ((1,), (1,))) * scale
         if causal:
             q_pos = i * bq + _iota(bq)
             k_pos = j * bk + _iota(bk)
             s = jnp.where(k_pos[None, :] <= q_pos[:, None], s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dp = _dot(do, v_ref[0].astype(jnp.float32), ((1,), (1,)))
+        dp = _dot(do_ref[0], v_ref[0], ((1,), (1,)))
         ds = p * (dp - delta[:, None]) * scale
-        acc_ref[...] += _dot(ds, k_blk, ((1,), (0,)))
+        acc_ref[...] += _dot(ds.astype(k_ref.dtype), k_ref[0], ((1,), (0,)))
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -179,22 +191,18 @@ def _dkv_kernel(
 
     @pl.when(run)
     def _compute():
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0, :]
         delta = delta_ref[0, 0, :]
-        s = _dot(q, k_blk, ((1,), (1,))) * scale
+        s = _dot(q_ref[0], k_ref[0], ((1,), (1,))) * scale
         if causal:
             q_pos = i * bq + _iota(bq)
             k_pos = j * bk + _iota(bk)
             s = jnp.where(k_pos[None, :] <= q_pos[:, None], s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv_acc[...] += _dot(p, do, ((0,), (0,)))
-        dp = _dot(do, v_blk, ((1,), (1,)))
+        dv_acc[...] += _dot(p.astype(do_ref.dtype), do_ref[0], ((0,), (0,)))
+        dp = _dot(do_ref[0], v_ref[0], ((1,), (1,)))
         ds = p * (dp - delta[:, None]) * scale
-        dk_acc[...] += _dot(ds, q, ((0,), (0,)))
+        dk_acc[...] += _dot(ds.astype(q_ref.dtype), q_ref[0], ((0,), (0,)))
 
     @pl.when(i == nq - 1)
     def _finish():
