@@ -126,8 +126,20 @@ def ddp_train_loop(
     import jax
     import optax
 
+    collectives = CollectivesTcp(timeout=timedelta(seconds=10))
+    extra = {}
+    if runner.train_loop_args.get("collectives_transport"):
+        # heal over the data plane itself (the PGTransport role,
+        # reference pg_transport.py) instead of the default HTTP server
+        from torchft_tpu.checkpointing.collectives_transport import (
+            CollectivesTransport,
+        )
+
+        extra["checkpoint_transport"] = CollectivesTransport(
+            collectives, timeout=timedelta(seconds=10)
+        )
     manager = Manager(
-        collectives=CollectivesTcp(timeout=timedelta(seconds=10)),
+        collectives=collectives,
         load_state_dict=None,  # wired by ManagedOptimizer.init
         state_dict=None,
         min_replica_size=2,
@@ -138,8 +150,14 @@ def ddp_train_loop(
         lighthouse_addr=runner.lighthouse_address,
         timeout=timedelta(seconds=10),
         quorum_timeout=timedelta(seconds=30),
+        **extra,
         **runner.manager_args,
     )
+    if "checkpoint_transport" in extra:
+        # the heal really rides the injected transport, not an HTTP
+        # fallback (metadata is what quorum peers fetch from)
+        assert manager._checkpoint_transport is extra["checkpoint_transport"]
+        assert manager._checkpoint_transport.metadata() == "<collectives>"
     try:
         opt = ManagedOptimizer(manager, optax.sgd(0.05))
         opt.init(_init_params())
@@ -170,6 +188,7 @@ def _run_groups(
     injectors: List[FailureInjector],
     world_size: int = 1,
     manager_args: Optional[Dict[str, Any]] = None,
+    train_loop_args: Optional[Dict[str, Any]] = None,
 ) -> List[List[Dict[str, Any]]]:
     num_replicas = len(injectors)
     with ThreadPoolExecutor(max_workers=num_replicas) as executor:
@@ -182,6 +201,7 @@ def _run_groups(
                     train_loop=ddp_train_loop,
                     world_size=world_size,
                     manager_args=manager_args or {},
+                    train_loop_args=train_loop_args or {},
                 ).run_replica
             )
             for replica_id, injector in enumerate(injectors)
@@ -209,7 +229,11 @@ def test_ddp_healthy():
 
 
 @pytest.mark.parametrize("use_async_quorum", [True, False])
-def test_ddp_recovery(use_async_quorum):
+@pytest.mark.parametrize("collectives_transport", [False, True])
+def test_ddp_recovery(use_async_quorum, collectives_transport):
+    """Recovery with the default HTTP transport and with the heal routed
+    over the data plane itself (CollectivesTransport — the PGTransport
+    role: windowed per-buffer sends on the freshly configured epoch)."""
     lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
     injectors = [FailureInjector(), FailureInjector().fail_at(0, 2)]
     try:
@@ -217,6 +241,7 @@ def test_ddp_recovery(use_async_quorum):
             lighthouse,
             injectors,
             manager_args={"use_async_quorum": use_async_quorum},
+            train_loop_args={"collectives_transport": collectives_transport},
         )
     finally:
         lighthouse.shutdown()
