@@ -29,6 +29,7 @@ import logging
 import os
 import sys
 import time
+from contextlib import contextmanager
 from datetime import timedelta
 
 import numpy as np
@@ -63,35 +64,25 @@ def _model_flops_per_step(cfg, n_params: int, batch: int, seq: int) -> float:
     return per_token * batch * seq
 
 
-def train_bench(cfg, batch, seq, steps, warmup, averaging: bool):
-    """Measured FT train loop; returns steps/s."""
+@contextmanager
+def _single_group_ft_runtime(replica_id: str):
+    """Full FT control plane for a 1-group bench: C++ lighthouse + store +
+    Manager over the device-path data plane (on a multi-group slice the
+    same code averages over the 'ft' mesh axis via ICI, no host staging).
+    Also clears jax caches first: compiled programs pin device buffers and
+    bench variants don't share shapes."""
     import gc
 
     import jax
 
-    # drop the previous variant's params/executables before allocating —
-    # compiled programs pin device buffers and variants don't share shapes
     gc.collect()
     jax.clear_caches()
-    import jax.numpy as jnp
-    import optax
 
     from torchft_tpu.collectives_device import CollectivesDevice
     from torchft_tpu.coordination import LighthouseServer
-    from torchft_tpu.ddp import allreduce_gradients
     from torchft_tpu.manager import Manager
-    from torchft_tpu.parallel.mesh import MeshConfig, make_mesh
-    from torchft_tpu.parallel.train_step import TrainStep
     from torchft_tpu.store import StoreServer
 
-    mesh = make_mesh(MeshConfig(dp=1))  # single chip; FT axis is cross-group
-    ts = TrainStep(cfg, optax.adamw(3e-4), mesh)
-    params = ts.init_params(jax.random.PRNGKey(0))
-    opt_state = ts.init_opt(params)
-
-    # full FT control plane, 1 replica group; the data plane is the
-    # device-path backend (CollectivesDevice) — on a multi-group slice the
-    # same code averages over the 'ft' mesh axis via ICI, no host staging
     lighthouse = LighthouseServer(bind="[::]:0", min_replicas=1)
     store = StoreServer()
     manager = Manager(
@@ -99,31 +90,52 @@ def train_bench(cfg, batch, seq, steps, warmup, averaging: bool):
         load_state_dict=lambda s: None,
         state_dict=lambda: {},
         min_replica_size=1,
-        replica_id="bench",
+        replica_id=replica_id,
         store_addr=store.address(),
         rank=0,
         world_size=1,
         lighthouse_addr=lighthouse.address(),
     )
-
-    rng = np.random.default_rng(0)
-    tokens = ts.shard_batch(
-        jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
-    )
-
-    def ft_step(params, opt_state):
-        # reference-faithful ordering (manager.py:546-599): quorum, grads,
-        # cross-group average, then the commit vote gates the optimizer
-        # step. apply() donates the old params only after the commit.
-        manager.start_quorum()
-        loss, grads = ts.grads(params, tokens)
-        if averaging:
-            grads = allreduce_gradients(manager, grads)
-        if manager.should_commit():
-            params, opt_state = ts.apply(params, opt_state, grads)
-        return loss, params, opt_state
-
     try:
+        yield manager
+    finally:
+        manager.shutdown(wait=False)
+        store.shutdown()
+        lighthouse.shutdown()
+
+
+def train_bench(cfg, batch, seq, steps, warmup, averaging: bool):
+    """Measured FT train loop; returns steps/s."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from torchft_tpu.ddp import allreduce_gradients
+    from torchft_tpu.parallel.mesh import MeshConfig, make_mesh
+    from torchft_tpu.parallel.train_step import TrainStep
+
+    with _single_group_ft_runtime("bench") as manager:
+        mesh = make_mesh(MeshConfig(dp=1))  # single chip; FT axis is cross-group
+        ts = TrainStep(cfg, optax.adamw(3e-4), mesh)
+        params = ts.init_params(jax.random.PRNGKey(0))
+        opt_state = ts.init_opt(params)
+        rng = np.random.default_rng(0)
+        tokens = ts.shard_batch(
+            jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+        )
+
+        def ft_step(params, opt_state):
+            # reference-faithful ordering (manager.py:546-599): quorum,
+            # grads, cross-group average, then the commit vote gates the
+            # optimizer step. apply() donates the old params post-commit.
+            manager.start_quorum()
+            loss, grads = ts.grads(params, tokens)
+            if averaging:
+                grads = allreduce_gradients(manager, grads)
+            if manager.should_commit():
+                params, opt_state = ts.apply(params, opt_state, grads)
+            return loss, params, opt_state
+
         for _ in range(warmup):
             loss, params, opt_state = ft_step(params, opt_state)
         float(loss)
@@ -135,10 +147,6 @@ def train_bench(cfg, batch, seq, steps, warmup, averaging: bool):
         # the final loss depends on the whole step chain
         float(loss)
         elapsed = time.perf_counter() - t0
-    finally:
-        manager.shutdown(wait=False)
-        store.shutdown()
-        lighthouse.shutdown()
 
     n_params = sum(
         int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params)
@@ -184,57 +192,35 @@ def _run_json_subprocess(cmd, timeout_s: float, env_extra=None) -> dict:
 
 def _resnet_bench(steps: int, warmup: int, batch: int) -> dict:
     """ResNet-18 imgs/s through the full FT loop (single group)."""
-    import gc
-
     import jax
-
-    gc.collect()
-    jax.clear_caches()
     import jax.numpy as jnp
     import optax
 
-    from torchft_tpu.collectives_device import CollectivesDevice
-    from torchft_tpu.coordination import LighthouseServer
     from torchft_tpu.ddp import allreduce_gradients
-    from torchft_tpu.manager import Manager
     from torchft_tpu.models import resnet
-    from torchft_tpu.store import StoreServer
 
-    cfg = resnet.ResNetConfig(dtype=jnp.bfloat16)
-    params, bn = resnet.init(jax.random.PRNGKey(0), cfg)
-    tx = optax.sgd(0.1, momentum=0.9)
-    opt_state = tx.init(params)
+    with _single_group_ft_runtime("bench_resnet") as manager:
+        cfg = resnet.ResNetConfig(dtype=jnp.bfloat16)
+        params, bn = resnet.init(jax.random.PRNGKey(0), cfg)
+        tx = optax.sgd(0.1, momentum=0.9)
+        opt_state = tx.init(params)
 
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((batch, 32, 32, 3)), jnp.float32)
-    y = jnp.asarray(rng.integers(0, 10, batch), jnp.int32)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((batch, 32, 32, 3)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 10, batch), jnp.int32)
 
-    @jax.jit
-    def grads_fn(params, bn):
-        (loss, new_bn), grads = jax.value_and_grad(
-            lambda p: resnet.loss_fn(p, bn, x, y, cfg), has_aux=True
-        )(params)
-        return loss, grads, new_bn
+        @jax.jit
+        def grads_fn(params, bn):
+            (loss, new_bn), grads = jax.value_and_grad(
+                lambda p: resnet.loss_fn(p, bn, x, y, cfg), has_aux=True
+            )(params)
+            return loss, grads, new_bn
 
-    @jax.jit
-    def apply_fn(params, opt_state, grads):
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state
+        @jax.jit
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
 
-    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=1)
-    store = StoreServer()
-    manager = Manager(
-        collectives=CollectivesDevice(timeout=timedelta(seconds=30)),
-        load_state_dict=lambda s: None,
-        state_dict=lambda: {},
-        min_replica_size=1,
-        replica_id="bench_resnet",
-        store_addr=store.address(),
-        rank=0,
-        world_size=1,
-        lighthouse_addr=lighthouse.address(),
-    )
-    try:
         def ft_step(params, opt_state, bn):
             manager.start_quorum()
             loss, grads, new_bn = grads_fn(params, bn)
@@ -252,10 +238,6 @@ def _resnet_bench(steps: int, warmup: int, batch: int) -> dict:
             loss, params, opt_state, bn = ft_step(params, opt_state, bn)
         float(loss)
         elapsed = time.perf_counter() - t0
-    finally:
-        manager.shutdown(wait=False)
-        store.shutdown()
-        lighthouse.shutdown()
     sps = steps / elapsed
     return {
         "steps_per_sec": round(sps, 4),

@@ -49,7 +49,7 @@ import jax.numpy as jnp
 import optax
 
 from torchft_tpu.collectives import CollectivesTcp
-from torchft_tpu.data import DistributedSampler
+from torchft_tpu.data import DistributedSampler, step_indices as batch_indices
 from torchft_tpu.manager import Manager
 from torchft_tpu.optim import ManagedOptimizer
 from torchft_tpu.store import StoreServer
@@ -76,9 +76,6 @@ def ensure_corpus(path: str) -> bytes:
         os.replace(tmp, path)  # atomic: concurrent groups race safely
     with open(path, "rb") as f:
         return f.read()
-
-
-from torchft_tpu.data import step_indices as batch_indices  # noqa: E402
 
 
 def main() -> None:

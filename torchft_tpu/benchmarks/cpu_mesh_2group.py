@@ -70,10 +70,11 @@ def _measure(averaging: bool, steps: int, warmup: int) -> float:
     from torchft_tpu.parallel.train_step import TrainStep
     from torchft_tpu.store import StoreServer
 
+    from torchft_tpu.utils.platform import pin_platform_from_env
+
     # the container's sitecustomize can register a TPU PJRT plugin that
-    # wins over JAX_PLATFORMS; pin the platform explicitly (tests/conftest
-    # does the same)
-    jax.config.update("jax_platforms", "cpu")
+    # wins over JAX_PLATFORMS; the pin makes the env var authoritative
+    pin_platform_from_env()
     devs = jax.devices()
     assert len(devs) >= 8, "needs xla_force_host_platform_device_count=8"
 

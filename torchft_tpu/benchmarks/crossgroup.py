@@ -64,13 +64,15 @@ def _worker_main(argv: List[str]) -> None:
     from torchft_tpu.manager import Manager
     from torchft_tpu.store import StoreServer
 
-    import jax
     import jax.numpy as jnp
 
-    # JAX_PLATFORMS=cpu alone loses to the container's TPU PJRT plugin
-    # (sitecustomize); pin explicitly so the worker never occupies the chip
-    # or pays tunnel transfers
-    jax.config.update("jax_platforms", "cpu")
+    from torchft_tpu.utils.platform import pin_platform_from_env
+
+    # the worker must NEVER occupy the chip or pay tunnel transfers —
+    # force cpu unconditionally (the docstring guarantee), then pin it so
+    # a sitecustomize-registered TPU plugin can't win over the env var
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    pin_platform_from_env()
 
     store = StoreServer()
     manager = Manager(
