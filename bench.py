@@ -138,7 +138,8 @@ def train_bench(cfg, batch, seq, steps, warmup, averaging: bool):
 
         for _ in range(warmup):
             loss, params, opt_state = ft_step(params, opt_state)
-        float(loss)
+        if warmup:
+            float(loss)  # fence warmup work out of the timed window
         t0 = time.perf_counter()
         for _ in range(steps):
             loss, params, opt_state = ft_step(params, opt_state)
@@ -232,7 +233,8 @@ def _resnet_bench(steps: int, warmup: int, batch: int) -> dict:
 
         for _ in range(warmup):
             loss, params, opt_state, bn = ft_step(params, opt_state, bn)
-        float(loss)
+        if warmup:
+            float(loss)  # fence warmup work out of the timed window
         t0 = time.perf_counter()
         for _ in range(steps):
             loss, params, opt_state, bn = ft_step(params, opt_state, bn)

@@ -562,3 +562,55 @@ def test_disk_checkpointer_needs_per_process_detection():
     assert arr.is_fully_addressable
     assert _needs_per_process({"w": arr}) is False
     assert _needs_per_process({"w": np.ones(3)}) is False
+
+
+def test_disk_dense_vs_proc_set_same_step_prefers_newer(tmp_path):
+    """Elastic resize can leave BOTH a dense file and a complete procIofN
+    set at the same step; restore must take the newer write, never merge
+    the stale one (round-3 review finding on _existing())."""
+    import os
+
+    from torchft_tpu.checkpointing.disk import DiskCheckpointer
+    from torchft_tpu.checkpointing.serialization import save_state
+
+    mgr = _ManagerStub()
+    mgr.step = 5
+    state = {"w": np.zeros(4, dtype=np.float32)}
+    ck = DiskCheckpointer(
+        str(tmp_path),
+        mgr,
+        state_dict=lambda: dict(state),
+        load_state_dict=lambda s: state.update(s),
+        tag="g0",
+    )
+
+    def write(path, w):
+        with open(path, "wb") as f:
+            save_state(
+                {"torchft": mgr.state_dict(), "user": {"w": w}}, f
+            )
+
+    stale = np.full(4, 1.0, dtype=np.float32)
+    fresh = np.full(4, 2.0, dtype=np.float32)
+
+    # older: a complete 2-process set; newer: a dense re-save (shrink to 1)
+    write(ck._proc_path(5, 0, 2), stale)
+    write(ck._proc_path(5, 1, 2), stale)
+    stale_mtime = os.path.getmtime(ck._proc_path(5, 0, 2))
+    write(ck._path(5), fresh)
+    # explicit times: guarantees strictly-newer even on coarse-granularity
+    # filesystems where sleep+now would truncate to the same second
+    os.utime(ck._path(5), (stale_mtime + 2, stale_mtime + 2))
+
+    assert ck.latest() == ck._path(5)
+    assert ck.restore()
+    np.testing.assert_array_equal(state["w"], fresh)
+
+    # the reverse: dense older, proc set newer -> proc set wins
+    for p in [ck._path(5), ck._proc_path(5, 0, 2), ck._proc_path(5, 1, 2)]:
+        os.remove(p)
+    write(ck._path(5), stale)
+    stale_mtime = os.path.getmtime(ck._path(5))
+    write(ck._proc_path(5, 0, 1), fresh)  # 1-process "set"
+    os.utime(ck._proc_path(5, 0, 1), (stale_mtime + 2, stale_mtime + 2))
+    assert ck.latest() == ck._proc_path(5, 0, 1)

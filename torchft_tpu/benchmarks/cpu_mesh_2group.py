@@ -70,10 +70,14 @@ def _measure(averaging: bool, steps: int, warmup: int) -> float:
     from torchft_tpu.parallel.train_step import TrainStep
     from torchft_tpu.store import StoreServer
 
+    import os as _os
+
     from torchft_tpu.utils.platform import pin_platform_from_env
 
-    # the container's sitecustomize can register a TPU PJRT plugin that
-    # wins over JAX_PLATFORMS; the pin makes the env var authoritative
+    # this bench must NEVER run on (or occupy) a real accelerator — force
+    # cpu unconditionally, then pin it so a sitecustomize-registered TPU
+    # plugin can't win over the env var
+    _os.environ["JAX_PLATFORMS"] = "cpu"
     pin_platform_from_env()
     devs = jax.devices()
     assert len(devs) >= 8, "needs xla_force_host_platform_device_count=8"
@@ -126,7 +130,8 @@ def _measure(averaging: bool, steps: int, warmup: int) -> float:
 
             for _ in range(warmup):
                 loss, params, opt_state = ft_step(params, opt_state)
-            float(loss)
+            if warmup:
+                float(loss)  # fence warmup work out of the timed window
             t0 = time.perf_counter()
             for _ in range(steps):
                 loss, params, opt_state = ft_step(params, opt_state)
