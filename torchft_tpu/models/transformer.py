@@ -332,14 +332,15 @@ def _make_stage_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
     return stage_fn
 
 
-def forward(
+def _hidden_states(
     params: Dict[str, Any],
     tokens: jnp.ndarray,
     cfg: TransformerConfig,
     mesh=None,
 ) -> jnp.ndarray:
-    """tokens [B, S] int32 -> logits [B, S, V] (compute in cfg.dtype,
-    logits in float32)."""
+    """tokens [B, S] -> final-norm hidden states [B, S, D] in cfg.dtype
+    (everything except the unembed — the chunked loss head consumes this
+    without ever materializing [S, V] logits)."""
     from torchft_tpu.parallel.pipeline import pipeline_forward
 
     b, s = tokens.shape
@@ -364,8 +365,19 @@ def forward(
         x_mb = pipeline_forward(layers, x_mb, stage_fn, mesh)
         x = x_mb.reshape(b, s, -1)
 
-    x = rms_norm(x, params["final_norm"].astype(dt), cfg.norm_eps)
-    return (x @ params["out"].astype(dt)).astype(jnp.float32)
+    return rms_norm(x, params["final_norm"].astype(dt), cfg.norm_eps)
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    mesh=None,
+) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, V] (compute in cfg.dtype,
+    logits in float32)."""
+    x = _hidden_states(params, tokens, cfg, mesh)
+    return (x @ params["out"].astype(cfg.dtype)).astype(jnp.float32)
 
 
 def loss_fn(
@@ -383,12 +395,105 @@ def loss_fn(
         # activations psum the plain forward() pays is for logits
         # consumers, not the training loop
         return _pipelined_loss(params, tokens, cfg, mesh)
+    b, s = tokens.shape
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    # Long-context memory wall: at s=32k vocab=32k the [B,S,V] f32 logits
+    # alone are >4 GB and softmax doubles it — the attention ceiling
+    # (flash) was solved but the HEAD would still OOM the chip. Chunk the
+    # sequence through the unembed instead. Budget is PER DEVICE (logits
+    # shard b over dp·fsdp and V over tp). Under sp>1 the s axis is
+    # already sharded and a global-s scan would fight that sharding: the
+    # dense path stays (its per-device logits are S/sp smaller), so scale
+    # very long context under sp by adding sp shards, not chunking.
+    if sp == 1 and _per_device_logit_elems(cfg, b, s, mesh) > _loss_chunk_elems():
+        return _chunked_loss(params, tokens, cfg, mesh)
     logits = forward(params, tokens, cfg, mesh)
     targets = jnp.roll(tokens, -1, axis=1)
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
     mask = jnp.ones_like(nll).at[:, -1].set(0.0)
     return jnp.sum(nll * mask) / jnp.sum(mask)
+
+
+def _loss_chunk_elems() -> int:
+    """Logit-element budget above which the loss head chunks the sequence
+    (default 2^27 ≈ 134M elems = 512 MB of f32 logits per live buffer).
+    Override via TORCHFT_TPU_LOSS_CHUNK_ELEMS (also how tests force the
+    chunked path on tiny shapes)."""
+    import os
+
+    try:
+        return int(os.environ.get("TORCHFT_TPU_LOSS_CHUNK_ELEMS", 1 << 27))
+    except ValueError:
+        return 1 << 27
+
+
+def _per_device_logit_elems(
+    cfg: TransformerConfig, batch: int, seq_len: int, mesh
+) -> int:
+    """Per-device element count of the dense [B, S, V] logits: b shards
+    over dp·fsdp, V over tp (the out matrix's tp sharding carries into
+    the logits)."""
+    batch_shards = vocab_shards = 1
+    if mesh is not None:
+        batch_shards = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        vocab_shards = mesh.shape.get("tp", 1)
+    return (
+        max(1, batch // batch_shards)
+        * seq_len
+        * max(1, cfg.vocab_size // vocab_shards)
+    )
+
+
+def _chunked_loss(
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    mesh=None,
+) -> jnp.ndarray:
+    """Cross entropy without materializing [B, S, V]: scan the unembed +
+    softmax over sequence chunks, ``jax.checkpoint`` on the body so the
+    backward rematerializes one chunk's logits at a time. Same numbers as
+    the dense path (f32 log_softmax per position; accumulation order
+    differs only in the final f32 sums)."""
+    b, s = tokens.shape
+    h = _hidden_states(params, tokens, cfg, mesh)
+    out_w = params["out"].astype(cfg.dtype)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.ones((b, s), jnp.float32).at[:, -1].set(0.0)
+
+    # chunk size straight from the per-device budget; s needn't divide —
+    # the tail chunk is padded and masked out (any s, prime or odd, gets
+    # full chunking)
+    budget = max(1, _loss_chunk_elems())
+    per_pos = _per_device_logit_elems(cfg, b, 1, mesh)
+    chunk = max(1, min(s, budget // max(1, per_pos)))
+    if chunk >= 128:
+        chunk -= chunk % 128  # lane-aligned chunks
+    n_chunks = -(-s // chunk)
+    pad = n_chunks * chunk - s
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))  # zeros: padded positions
+
+    hs = jnp.moveaxis(h.reshape(b, n_chunks, chunk, -1), 1, 0)
+    ts = jnp.moveaxis(targets.reshape(b, n_chunks, chunk), 1, 0)
+    ms = jnp.moveaxis(mask.reshape(b, n_chunks, chunk), 1, 0)
+
+    @jax.checkpoint
+    def body(carry, xt):
+        h_c, t_c, m_c = xt
+        logits = (h_c @ out_w).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, t_c[..., None], axis=-1)[..., 0]
+        nll_sum, cnt = carry
+        return (nll_sum + jnp.sum(nll * m_c), cnt + jnp.sum(m_c)), None
+
+    (nll_sum, cnt), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), (hs, ts, ms)
+    )
+    return nll_sum / cnt
 
 
 def _pipelined_loss(
