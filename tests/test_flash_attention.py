@@ -122,7 +122,7 @@ def test_use_flash_auto_threshold(monkeypatch):
 def test_chunked_loss_matches_dense(monkeypatch):
     """Long-context loss head: chunked cross entropy (scan over the
     unembed, [S,V] logits never materialized) must match the dense path
-    bit-for-bit in value and to float noise in grads."""
+    to f32 accumulation noise in value and grads."""
     import jax
     import jax.numpy as jnp
     import numpy as np

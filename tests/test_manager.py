@@ -336,3 +336,30 @@ def test_pipelined_averaging_latches_midway_error(harness):
 
     h.client.should_commit.return_value = False
     assert m.should_commit() is False
+
+
+def test_start_quorum_retries_after_timeout(harness):
+    """A timed-out quorum must not poison the Manager: the next
+    start_quorum is the caller's retry and starts fresh (a loaded host
+    can blow one deadline without ending the training process)."""
+    h = harness()
+    m = h.manager
+
+    slow = {"n": 0}
+
+    def quorum_side_effect(**kwargs):
+        slow["n"] += 1
+        if slow["n"] == 1:
+            raise TimeoutError("quorum deadline exceeded")
+        return quorum_result(max_rank=1)
+
+    h.client._quorum.side_effect = quorum_side_effect
+
+    m.start_quorum()
+    with pytest.raises(TimeoutError):
+        m.wait_quorum()
+
+    # retry succeeds on a fresh quorum future
+    m.start_quorum()
+    m.wait_quorum()
+    assert m.num_participants() == 2

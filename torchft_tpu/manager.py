@@ -259,7 +259,14 @@ class Manager:
         downscale)."""
         # wait for a previous quorum to finish before mutating state
         if self._quorum_future is not None:
-            self._quorum_future.result()
+            try:
+                self._quorum_future.result()
+            except Exception as e:  # noqa: BLE001
+                # the failure already surfaced to the caller through
+                # wait_quorum/allreduce/should_commit on the step that
+                # scheduled it; calling start_quorum again IS the retry —
+                # start fresh instead of re-raising history forever
+                self._logger.warn(f"previous quorum attempt failed ({e}); retrying")
 
         self._errored = None
         self._healing = False
