@@ -614,3 +614,84 @@ def test_disk_dense_vs_proc_set_same_step_prefers_newer(tmp_path):
     write(ck._proc_path(5, 0, 1), fresh)  # 1-process "set"
     os.utime(ck._proc_path(5, 0, 1), (stale_mtime + 2, stale_mtime + 2))
     assert ck.latest() == ck._proc_path(5, 0, 1)
+
+
+def test_disk_write_generation_beats_mtime(tmp_path):
+    """Deterministic dense-vs-procset arbitration (round-3 advisor low):
+    a later incarnation's write wins via its higher write generation even
+    when filesystem mtimes tie or INVERT (1 s granularity, clock skew)."""
+    import os
+
+    from torchft_tpu.checkpointing.disk import DiskCheckpointer
+    from torchft_tpu.checkpointing.serialization import save_state
+
+    mgr = _ManagerStub()
+    mgr.step = 5
+
+    def write(path, w):
+        with open(path, "wb") as f:
+            save_state({"torchft": mgr.state_dict(), "user": {"w": w}}, f)
+
+    stale = np.full(4, 1.0, dtype=np.float32)
+    fresh = np.full(4, 2.0, dtype=np.float32)
+
+    # incarnation 1 (fresh dir -> gen 0, legacy names): 2-process set
+    ck1 = DiskCheckpointer(
+        str(tmp_path), mgr, state_dict=dict, load_state_dict=lambda s: None, tag="g0"
+    )
+    assert ck1._gen == 0
+    write(ck1._proc_path(5, 0, 2), stale)
+    write(ck1._proc_path(5, 1, 2), stale)
+
+    # incarnation 2 (resized to 1 process): scans -> gen 1
+    state2 = {}
+    ck2 = DiskCheckpointer(
+        str(tmp_path),
+        mgr,
+        state_dict=dict,
+        load_state_dict=lambda s: state2.update(s),
+        tag="g0",
+    )
+    assert ck2._gen == 1
+    write(ck2._path(5), fresh)
+    # adversarial: make the NEWER write look mtime-OLDER; gen must win
+    old = os.path.getmtime(ck1._proc_path(5, 0, 2)) - 10
+    os.utime(ck2._path(5), (old, old))
+    assert ck2.latest() == ck2._path(5)
+    assert ck2.restore()
+    np.testing.assert_array_equal(state2["w"], fresh)
+
+    # a third incarnation keeps climbing
+    ck3 = DiskCheckpointer(
+        str(tmp_path), mgr, state_dict=dict, load_state_dict=lambda s: None, tag="g0"
+    )
+    assert ck3._gen == 2
+
+
+def test_disk_prune_removes_superseded_generations(tmp_path):
+    """A crash-restart loop re-saving around the same step must not leak
+    one full checkpoint per incarnation: _prune deletes same-step files of
+    strictly lower generation than the arbitration winner."""
+    from torchft_tpu.checkpointing.disk import DiskCheckpointer
+
+    state = {"w": np.zeros(2, dtype=np.float32)}
+    names = lambda: sorted(  # noqa: E731
+        p.name for p in tmp_path.iterdir() if p.suffix == ".ckpt"
+    )
+    for incarnation in range(3):
+        mgr = _ManagerStub()
+        ck = DiskCheckpointer(
+            str(tmp_path),
+            mgr,
+            state_dict=lambda: dict(state),
+            load_state_dict=lambda s: state.update(s),
+            every=1,
+            keep=3,
+            tag="g0",
+        )
+        assert ck._gen == incarnation
+        ck.restore()
+        mgr.step = 5  # dies near the same step every time
+        ck.save()
+    # only the newest generation's file survives at step 5
+    assert names() == ["g0_step5.g2.ckpt"]

@@ -266,6 +266,26 @@ class TestWirePipeline:
             # bf16 has ~3 decimal digits; per-hop requantization over a
             # 3-ring stays within a few ulps of that
             np.testing.assert_allclose(out, expect, rtol=3e-2, atol=3e-2)
+        # lossy wire must still be DETERMINISTICALLY lossy: every rank
+        # holds the bitwise-identical result, or replica groups that use
+        # bf16-wire gradient averaging silently diverge (round-3 advisor
+        # high finding: the chunk owner kept full f32 while peers stored
+        # the bf16-rounded copy)
+        for out in outs[1:]:
+            np.testing.assert_array_equal(out, outs[0])
+
+    def test_bf16_wire_bitwise_identical_world4(self, store):
+        # uneven chunks + SUM: same bitwise-equality invariant
+        def fn(c, rank):
+            rng = np.random.default_rng(17 + rank)
+            arr = rng.standard_normal(7331).astype(np.float32)
+            return c.allreduce([arr], ReduceOp.SUM).wait(
+                timedelta(seconds=30)
+            )[0]
+
+        outs = _run_world(store, 4, fn, prefix="bf16bw4", wire_dtype="bfloat16")
+        for out in outs[1:]:
+            np.testing.assert_array_equal(out, outs[0])
 
     def test_out_of_order_tags_are_matched(self, store):
         # rank 0 sends tag B then tag A; rank 1 waits for A first: the

@@ -18,6 +18,7 @@ its preallocated array (zero-copy ``into=`` receive).
 
 from __future__ import annotations
 
+import itertools
 import logging
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
@@ -44,15 +45,32 @@ __all__ = ["CollectivesTransport"]
 _META_TAG = 0x00CC01
 # Per-buffer data tags cycle within a 4096 window: in-flight reordering is
 # bounded by _WINDOW (≪ 4096), so a cycled tag can never collide with a
-# frame still in flight.
+# frame still in flight.  Tags are additionally SALTED per transfer with a
+# sender-chosen nonce carried in the length frame: if a recv_checkpoint
+# attempt dies mid-window, its abandoned in-flight recvs keep their old
+# tags and can never claim a frame belonging to the retry (round-3 advisor
+# finding).  16 salts cycle; a stale recv from 16 transfers ago is long
+# dead (or the epoch was reconfigured, which poisons it anyway).
 _DATA_TAG0 = 0x0D0000
 _TAG_CYCLE = 4096
 _WINDOW = 3
 _MAX_DST_PARALLEL = 4
 
+_TRANSFER_SALT = itertools.count(1)  # process-global: survives re-instantiation
 
-def _data_tag(i: int) -> int:
-    return _DATA_TAG0 + (i % _TAG_CYCLE)
+# Only the LENGTH frame uses the fixed _META_TAG (the receiver can't know
+# the salt before reading it); the header frame is already salted so an
+# attempt that died between the length and header recvs can't have its
+# abandoned header recv claim the retry's frames.
+_HDR_TAG0 = 0x00CD00
+
+
+def _hdr_tag(salt: int) -> int:
+    return _HDR_TAG0 | (salt & 0xF)
+
+
+def _data_tag(salt: int, i: int) -> int:
+    return _DATA_TAG0 | ((salt & 0xF) << 12) | (i % _TAG_CYCLE)
 
 
 class CollectivesTransport(CheckpointTransport[T], Generic[T]):
@@ -76,11 +94,12 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
         hdr_arr: np.ndarray,
         buffers: List[np.ndarray],
         timeout: timedelta,
+        salt: int,
     ) -> None:
         from collections import deque
 
         self._collectives.send(len_arr, dst, tag=_META_TAG).wait(timeout)
-        self._collectives.send(hdr_arr, dst, tag=_META_TAG).wait(timeout)
+        self._collectives.send(hdr_arr, dst, tag=_hdr_tag(salt)).wait(timeout)
         window: Deque = deque()
         for i, buf in enumerate(buffers):
             while len(window) >= self._window:
@@ -89,7 +108,7 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
                 self._collectives.send(
                     np.frombuffer(as_bytes(buf), dtype=np.uint8),
                     dst,
-                    tag=_data_tag(i),
+                    tag=_data_tag(salt, i),
                 )
             )
         while window:
@@ -100,16 +119,21 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
     ) -> None:
         header, buffers = flatten_state(state_dict)
         hdr_arr = np.frombuffer(header, dtype=np.uint8)
-        len_arr = np.array([len(header)], dtype=np.int64)
+        salt = next(_TRANSFER_SALT)
+        # the salt rides in the length frame so the receiver tags its
+        # windowed recvs identically without an extra round-trip
+        len_arr = np.array([len(header), salt], dtype=np.int64)
         if len(dst_ranks) == 1:
-            self._send_one(dst_ranks[0], len_arr, hdr_arr, buffers, timeout)
+            self._send_one(dst_ranks[0], len_arr, hdr_arr, buffers, timeout, salt)
             return
         with ThreadPoolExecutor(
             max_workers=min(_MAX_DST_PARALLEL, len(dst_ranks)),
             thread_name_prefix="tft_ckpt_send",
         ) as pool:
             futs = [
-                pool.submit(self._send_one, dst, len_arr, hdr_arr, buffers, timeout)
+                pool.submit(
+                    self._send_one, dst, len_arr, hdr_arr, buffers, timeout, salt
+                )
                 for dst in dst_ranks
             ]
             for f in futs:
@@ -120,10 +144,11 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
     ) -> T:
         from collections import deque
 
-        len_arr = np.zeros(1, dtype=np.int64)
+        len_arr = np.zeros(2, dtype=np.int64)
         self._collectives.recv(len_arr, src_rank, tag=_META_TAG).wait(timeout)
+        salt = int(len_arr[1])
         hdr_arr = np.zeros(int(len_arr[0]), dtype=np.uint8)
-        self._collectives.recv(hdr_arr, src_rank, tag=_META_TAG).wait(timeout)
+        self._collectives.recv(hdr_arr, src_rank, tag=_hdr_tag(salt)).wait(timeout)
         header = hdr_arr.tobytes()
 
         import pickle
@@ -137,7 +162,7 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
             buf = np.zeros(nbytes, dtype=np.uint8)
             buffers.append(buf)
             window.append(
-                self._collectives.recv(buf, src_rank, tag=_data_tag(i))
+                self._collectives.recv(buf, src_rank, tag=_data_tag(salt, i))
             )
         while window:
             window.popleft().wait(timeout)

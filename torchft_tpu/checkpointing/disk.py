@@ -47,7 +47,8 @@ logger = logging.getLogger(__name__)
 __all__ = ["DiskCheckpointer"]
 
 _NAME = re.compile(
-    r"^(?P<tag>.+)_step(?P<step>\d+)(?:\.proc(?P<pidx>\d+)of(?P<pcount>\d+))?\.ckpt$"
+    r"^(?P<tag>.+)_step(?P<step>\d+)(?:\.g(?P<gen>\d+))?"
+    r"(?:\.proc(?P<pidx>\d+)of(?P<pcount>\d+))?\.ckpt$"
 )
 
 
@@ -168,7 +169,27 @@ class DiskCheckpointer:
         # progress gate: never snapshot the step we started at (a pristine
         # step-0 checkpoint on a fresh start is pure noise)
         self._last_saved = manager.current_step()
+        # Write generation: arbitration between a dense file and a stale
+        # procIofN set at the SAME step must not hinge on filesystem mtime
+        # (1 s granularity can tie or invert — round-3 advisor finding).
+        # Each incarnation claims max(existing gen)+1 once at construction;
+        # every process of a group constructs before any writes (quorum
+        # gates the first save), so the whole group shares one generation.
+        # Generation 0 keeps the legacy suffix-free filename.
+        self._gen = self._scan_max_gen()
         self._cleanup_stale()
+
+    def _scan_max_gen(self) -> int:
+        try:
+            names = os.listdir(self._dir)
+        except FileNotFoundError:
+            return 0
+        gens = [
+            int(m.group("gen") or 0)
+            for m in (_NAME.match(n) for n in names)
+            if m and m.group("tag") == self._tag
+        ]
+        return max(gens) + 1 if gens else 0
 
     def _cleanup_stale(self) -> None:
         for name in os.listdir(self._dir):
@@ -193,12 +214,19 @@ class DiskCheckpointer:
 
     # -- paths --
 
+    def _gen_suffix(self) -> str:
+        return f".g{self._gen}" if self._gen else ""
+
     def _path(self, step: int) -> str:
-        return os.path.join(self._dir, f"{self._tag}_step{step}.ckpt")
+        return os.path.join(
+            self._dir, f"{self._tag}_step{step}{self._gen_suffix()}.ckpt"
+        )
 
     def _proc_path(self, step: int, pidx: int, pcount: int) -> str:
         return os.path.join(
-            self._dir, f"{self._tag}_step{step}.proc{pidx}of{pcount}.ckpt"
+            self._dir,
+            f"{self._tag}_step{step}{self._gen_suffix()}"
+            f".proc{pidx}of{pcount}.ckpt",
         )
 
     def _existing(self) -> List[Tuple[int, List[str]]]:
@@ -206,8 +234,8 @@ class DiskCheckpointer:
         dense checkpoint is one file; a per-process checkpoint counts only
         when all N ``procIofN`` files are present (a host that died
         mid-save must not offer a half checkpoint as restorable)."""
-        dense: dict = {}
-        procs: dict = {}
+        dense: dict = {}  # step -> (gen, path), highest gen wins
+        procs: dict = {}  # (step, gen) -> {pidx: (path, pcount)}
         try:
             names = os.listdir(self._dir)
         except FileNotFoundError:
@@ -217,38 +245,62 @@ class DiskCheckpointer:
             if not m or m.group("tag") != self._tag:
                 continue
             step = int(m.group("step"))
+            gen = int(m.group("gen") or 0)
             path = os.path.join(self._dir, name)
             if m.group("pidx") is None:
-                dense[step] = path
+                if step not in dense or gen > dense[step][0]:
+                    dense[step] = (gen, path)
             else:
-                procs.setdefault(step, {})[int(m.group("pidx"))] = (
+                procs.setdefault((step, gen), {})[int(m.group("pidx"))] = (
                     path,
                     int(m.group("pcount")),
                 )
-        complete_procs: dict = {}
-        for step, by_idx in procs.items():
+        # a procset is complete only when all N files of ONE generation are
+        # present; the best complete set per step is the highest generation
+        complete_procs: dict = {}  # step -> (gen, [paths])
+        for (step, gen), by_idx in procs.items():
             counts = {pcount for _, pcount in by_idx.values()}
             if len(counts) == 1 and len(by_idx) == next(iter(counts)):
-                complete_procs[step] = [by_idx[i][0] for i in sorted(by_idx)]
+                if step not in complete_procs or gen > complete_procs[step][0]:
+                    complete_procs[step] = (
+                        gen,
+                        [by_idx[i][0] for i in sorted(by_idx)],
+                    )
 
-        def _mtime(paths: List[str]) -> float:
-            try:
-                return max(os.path.getmtime(p) for p in paths)
-            except OSError:
-                return 0.0
+        def _mtime_ns(paths: List[str]) -> int:
+            # best-effort legacy tiebreak only: ignore unstatable members
+            # rather than zeroing the whole set (round-3 advisor finding)
+            times = []
+            for p in paths:
+                try:
+                    times.append(os.stat(p).st_mtime_ns)
+                except OSError:
+                    pass
+            return max(times, default=0)
 
         out: List[Tuple[int, List[str]]] = []
         for step in dense.keys() | complete_procs.keys():
             # one entry per step: an elastic resize can leave BOTH a dense
             # file and a stale complete procIofN set (or vice versa) at the
-            # same step — offer only the newer write, never a stale merge
+            # same step — offer only the newer write, never a stale merge.
+            # Order of preference: higher write generation (deterministic),
+            # then ns mtime (legacy gen-0 files), then dense (stable).
             if step in dense and step in complete_procs:
-                d, p = [dense[step]], complete_procs[step]
-                out.append((step, d if _mtime(d) >= _mtime(p) else p))
+                dg, dpath = dense[step]
+                pg, ppaths = complete_procs[step]
+                if dg != pg:
+                    pick = [dpath] if dg > pg else ppaths
+                else:
+                    pick = (
+                        [dpath]
+                        if _mtime_ns([dpath]) >= _mtime_ns(ppaths)
+                        else ppaths
+                    )
+                out.append((step, pick))
             elif step in dense:
-                out.append((step, [dense[step]]))
+                out.append((step, [dense[step][1]]))
             else:
-                out.append((step, complete_procs[step]))
+                out.append((step, complete_procs[step][1]))
         return sorted(out)
 
     def latest(self) -> Optional[str]:
@@ -370,6 +422,15 @@ class DiskCheckpointer:
         if not kept:
             return
         floor = kept[0][0]
+        # winning generation per retained step: files AT a retained step
+        # from a strictly older generation lost arbitration and would
+        # otherwise accumulate one full checkpoint per crash-restart
+        # incarnation (each incarnation writes distinct .gK names)
+        win_gen = {}
+        for step, paths in kept:
+            m = _NAME.match(os.path.basename(paths[0]))
+            if m:
+                win_gen[step] = int(m.group("gen") or 0)
         try:
             names = os.listdir(self._dir)
         except FileNotFoundError:
@@ -379,9 +440,13 @@ class DiskCheckpointer:
             if not m or m.group("tag") != self._tag:
                 continue
             path = os.path.join(self._dir, name)
+            step = int(m.group("step"))
+            gen = int(m.group("gen") or 0)
             # every kept entry has step >= floor, so step < floor alone
-            # proves the file is not retained
-            if int(m.group("step")) < floor:
+            # proves the file is not retained; at a retained step, only a
+            # strictly LOWER generation is provably dead (a higher one may
+            # be a newer incarnation mid-write)
+            if step < floor or gen < win_gen.get(step, 0):
                 try:
                     os.remove(path)
                 except OSError:

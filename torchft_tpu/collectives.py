@@ -686,6 +686,15 @@ class CollectivesTcp(Collectives):
             )
             incoming = view.astype(np.float32) if compress else view
             reduce_fn(chunks[recv_idx], incoming.reshape(chunks[recv_idx].shape))
+        # With lossy wire compression the owner of each fully reduced chunk
+        # must hold the same wire-rounded value every other rank receives,
+        # or ranks silently diverge (the owner keeps full f32 while peers
+        # store the bf16-rounded copy).  Round-trip the owned chunk through
+        # the wire dtype before the allgather phase so the result is
+        # bitwise identical on every rank.
+        if compress:
+            owned = chunks[(rank + 1) % world]
+            owned[:] = owned.astype(wire).astype(arr.dtype)
         # allgather phase
         for step in range(world - 1):
             send_idx = (rank + 1 - step) % world
