@@ -1,0 +1,845 @@
+// torchft_tpu native core — striped cross-process gradient data plane.
+// See dataplane.h for the design rationale.
+
+#include "dataplane.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cmath>
+#include <cstring>
+#include <limits>
+#include <stdexcept>
+
+#include "rpc.h"  // tcp_listen / tcp_connect / listen_port / now_ms
+
+namespace tft {
+
+namespace {
+
+constexpr uint32_t kHelloMagic = 0x7F7A0D01;  // distinct from control hello
+constexpr int kSockBuf = 1 << 22;             // 4 MB: loopback throughput
+
+struct HopHdr {
+  uint32_t tag;
+  uint32_t len;
+};
+
+struct CmaDesc {
+  uint32_t tag;
+  uint32_t len;
+  uint64_t addr;
+};
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void tune_socket(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int buf = kSockBuf;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+}
+
+// bf16 round-to-nearest-even, matching numpy/ml_dtypes astype semantics
+// for the values gradients take (the Python wire codec this plane must be
+// bitwise-consistent with — collectives.py pack()/round-trip).
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  if ((x & 0x7FFFFFFFu) > 0x7F800000u) {  // NaN: quiet, keep payload bit
+    return (uint16_t)((x >> 16) | 0x0040);
+  }
+  uint32_t lsb = (x >> 16) & 1u;
+  x += 0x7FFFu + lsb;
+  return (uint16_t)(x >> 16);
+}
+
+inline float bf16_to_f32(uint16_t h) {
+  uint32_t x = ((uint32_t)h) << 16;
+  float f;
+  std::memcpy(&f, &x, 4);
+  return f;
+}
+
+void encode_bf16(const float* src, uint16_t* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) dst[i] = f32_to_bf16(src[i]);
+}
+
+// NaN-propagating max/min, matching np.maximum/np.minimum (the Python
+// ring's semantics): a NaN in either operand wins — allreduce-MAX is used
+// as a grad-norm overflow tripwire and must not launder NaN away.
+inline float nan_max(float a, float b) {
+  if (std::isnan(a) || std::isnan(b)) return std::numeric_limits<float>::quiet_NaN();
+  return a > b ? a : b;
+}
+inline float nan_min(float a, float b) {
+  if (std::isnan(a) || std::isnan(b)) return std::numeric_limits<float>::quiet_NaN();
+  return a < b ? a : b;
+}
+
+void reduce_f32(float* acc, const float* in, size_t n, DpOp op) {
+  switch (op) {
+    case DpOp::kSum:
+    case DpOp::kAvg:
+      for (size_t i = 0; i < n; ++i) acc[i] += in[i];
+      break;
+    case DpOp::kMax:
+      for (size_t i = 0; i < n; ++i) acc[i] = nan_max(acc[i], in[i]);
+      break;
+    case DpOp::kMin:
+      for (size_t i = 0; i < n; ++i) acc[i] = nan_min(acc[i], in[i]);
+      break;
+  }
+}
+
+void reduce_from_bf16(float* acc, const uint16_t* in, size_t n, DpOp op) {
+  switch (op) {
+    case DpOp::kSum:
+    case DpOp::kAvg:
+      for (size_t i = 0; i < n; ++i) acc[i] += bf16_to_f32(in[i]);
+      break;
+    case DpOp::kMax:
+      for (size_t i = 0; i < n; ++i) acc[i] = nan_max(acc[i], bf16_to_f32(in[i]));
+      break;
+    case DpOp::kMin:
+      for (size_t i = 0; i < n; ++i) acc[i] = nan_min(acc[i], bf16_to_f32(in[i]));
+      break;
+  }
+}
+
+// poll-bounded helpers for the tiny CMA control messages (they always fit
+// the socket buffer, so these loops complete in one or two iterations)
+bool send_small(int fd, const void* buf, size_t n, int64_t deadline_ms,
+                bool* timed_out, std::string* err) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t k = ::send(fd, (const uint8_t*)buf + off, n - off, MSG_NOSIGNAL);
+    if (k > 0) {
+      off += (size_t)k;
+      continue;
+    }
+    if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      int64_t left = deadline_ms - now_ms();
+      if (left <= 0) {
+        *timed_out = true;
+        *err = "send deadline exceeded";
+        return false;
+      }
+      pollfd pfd{fd, POLLOUT, 0};
+      ::poll(&pfd, 1, (int)(left > 200 ? 200 : left));
+      continue;
+    }
+    *err = std::string("send: ") + (k == 0 ? "closed" : strerror(errno));
+    return false;
+  }
+  return true;
+}
+
+bool recv_small(int fd, void* buf, size_t n, int64_t deadline_ms,
+                bool* timed_out, std::string* err) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t k = ::recv(fd, (uint8_t*)buf + off, n - off, 0);
+    if (k > 0) {
+      off += (size_t)k;
+      continue;
+    }
+    if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      int64_t left = deadline_ms - now_ms();
+      if (left <= 0) {
+        *timed_out = true;
+        *err = "recv deadline exceeded";
+        return false;
+      }
+      pollfd pfd{fd, POLLIN, 0};
+      ::poll(&pfd, 1, (int)(left > 200 ? 200 : left));
+      continue;
+    }
+    *err = std::string("recv: ") + (k == 0 ? "closed" : strerror(errno));
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+DataPlane::DataPlane(int rank, int world, int nstripes)
+    : rank_(rank), world_(world), nstripes_(nstripes) {
+  std::string err;
+  listen_fd_ = tcp_listen("[::]:0", &err);
+  if (listen_fd_ < 0) {
+    throw std::runtime_error("dataplane listen failed: " + err);
+  }
+  port_ = listen_port(listen_fd_);
+  for (int s = 0; s < nstripes_; ++s) {
+    stripes_.push_back(std::make_unique<Stripe>());
+  }
+  for (int s = 0; s < nstripes_; ++s) {
+    stripes_[s]->worker = std::thread([this, s] { worker_loop(s); });
+  }
+  acceptor_ = std::thread([this] { accept_loop(); });
+}
+
+DataPlane::~DataPlane() { shutdown(); }
+
+void DataPlane::shutdown() {
+  bool was = closed_.exchange(true);
+  if (was) return;
+  // wake the acceptor
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+  }
+  // unblock any in-flight hop
+  {
+    std::lock_guard<std::mutex> g(socks_mu_);
+    for (auto& kv : socks_) {
+      for (int fd : kv.second) {
+        if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+      }
+    }
+    socks_cv_.notify_all();
+  }
+  // wake + join workers
+  for (auto& st : stripes_) {
+    {
+      std::lock_guard<std::mutex> g(st->mu);
+      st->cv.notify_all();
+    }
+    if (st->worker.joinable()) st->worker.join();
+  }
+  if (acceptor_.joinable()) acceptor_.join();
+  {
+    // in-flight hellos: shut their fds so the reads fail fast, then join
+    std::lock_guard<std::mutex> g(hello_mu_);
+    for (int fd : hello_fds_) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (;;) {
+    std::thread t;
+    {
+      std::lock_guard<std::mutex> g(hello_mu_);
+      if (hello_threads_.empty()) break;
+      t = std::move(hello_threads_.back());
+      hello_threads_.pop_back();
+    }
+    if (t.joinable()) t.join();
+  }
+  {
+    std::lock_guard<std::mutex> g(socks_mu_);
+    for (auto& kv : socks_) {
+      for (int& fd : kv.second) {
+        if (fd >= 0) ::close(fd);
+        fd = -1;
+      }
+    }
+  }
+  listen_fd_ = -1;
+}
+
+void DataPlane::accept_loop() {
+  while (!closed_.load()) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (closed_.load()) return;
+      if (errno == EINTR) continue;
+      return;  // listener closed
+    }
+    // hello runs on its own short-lived thread: one stalled or garbage
+    // connection must not starve the other world*nstripes dials of the
+    // rendezvous window (round-4 review finding)
+    std::lock_guard<std::mutex> g(hello_mu_);
+    if (closed_.load()) {
+      ::close(fd);
+      return;
+    }
+    hello_fds_.insert(fd);
+    hello_threads_.emplace_back([this, fd] { hello_handshake(fd); });
+  }
+}
+
+void DataPlane::hello_handshake(int fd) {
+  // hello: {magic, rank, stripe} — bounded read
+  uint32_t hello[3];
+  bool ok = read_exact(fd, hello, sizeof(hello), now_ms() + 10000) &&
+            hello[0] == kHelloMagic;
+  int peer = ok ? (int)hello[1] : -1;
+  int stripe = ok ? (int)hello[2] : -1;
+  {
+    std::lock_guard<std::mutex> g(hello_mu_);
+    hello_fds_.erase(fd);
+  }
+  if (!ok || peer < 0 || peer >= world_ || stripe < 0 ||
+      stripe >= nstripes_) {
+    ::close(fd);
+    return;
+  }
+  tune_socket(fd);
+  set_nonblock(fd);
+  std::lock_guard<std::mutex> g(socks_mu_);
+  if (closed_.load()) {
+    ::close(fd);
+    return;
+  }
+  auto& v = socks_[peer];
+  if (v.empty()) v.assign(nstripes_, -1);
+  if (v[stripe] >= 0) ::close(v[stripe]);
+  v[stripe] = fd;
+  socks_cv_.notify_all();
+}
+
+bool DataPlane::connect_peer(int peer, const std::string& host, int port,
+                             int64_t timeout_ms, std::string* err) {
+  for (int s = 0; s < nstripes_; ++s) {
+    int fd = tcp_connect(host, port, timeout_ms, err);
+    if (fd < 0) return false;
+    uint32_t hello[3] = {kHelloMagic, (uint32_t)rank_, (uint32_t)s};
+    if (!write_all(fd, hello, sizeof(hello))) {
+      ::close(fd);
+      *err = "hello write failed";
+      return false;
+    }
+    tune_socket(fd);
+    set_nonblock(fd);
+    std::lock_guard<std::mutex> g(socks_mu_);
+    auto& v = socks_[peer];
+    if (v.empty()) v.assign(nstripes_, -1);
+    if (v[s] >= 0) ::close(v[s]);
+    v[s] = fd;
+  }
+  return true;
+}
+
+bool DataPlane::wait_ready(int64_t timeout_ms, std::string* err) {
+  int64_t deadline = now_ms() + timeout_ms;
+  std::unique_lock<std::mutex> g(socks_mu_);
+  for (;;) {
+    bool ready = true;
+    for (int p = 0; p < world_ && ready; ++p) {
+      if (p == rank_) continue;
+      auto it = socks_.find(p);
+      if (it == socks_.end()) {
+        ready = false;
+        break;
+      }
+      for (int fd : it->second) {
+        if (fd < 0) {
+          ready = false;
+          break;
+        }
+      }
+    }
+    if (ready) return true;
+    if (closed_.load()) {
+      *err = "dataplane shut down";
+      return false;
+    }
+    int64_t left = deadline - now_ms();
+    if (left <= 0) {
+      *err = "timeout waiting for stripe peers";
+      return false;
+    }
+    socks_cv_.wait_for(g, std::chrono::milliseconds(left > 100 ? 100 : left));
+  }
+}
+
+int DataPlane::fd_for(int peer, int stripe) {
+  std::lock_guard<std::mutex> g(socks_mu_);
+  auto it = socks_.find(peer);
+  if (it == socks_.end() || it->second[stripe] < 0) return -1;
+  return it->second[stripe];
+}
+
+// Full-duplex pump: send sn bytes (header+payload already framed by the
+// caller into sbuf layout via two-phase state) while receiving rn bytes.
+// Uses poll() on both fds so a full send buffer can't deadlock against a
+// peer doing the same (the reason the Python path burned a thread per hop).
+bool DataPlane::hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
+                    uint8_t* rbuf, size_t rn, uint32_t tag,
+                    int64_t deadline_ms, bool* send_failed, bool* timed_out,
+                    std::string* err) {
+  HopHdr shdr{tag, (uint32_t)sn};
+  HopHdr rhdr{0, 0};
+  size_t s_off = 0, r_off = 0;
+  size_t sh_off = 0, rh_off = 0;  // header progress
+  *send_failed = false;
+
+  while (sh_off < sizeof(shdr) || s_off < sn || rh_off < sizeof(rhdr) ||
+         r_off < rn) {
+    struct pollfd pfd[2];
+    int n = 0;
+    int send_i = -1, recv_i = -1;
+    if (sh_off < sizeof(shdr) || s_off < sn) {
+      pfd[n].fd = send_fd;
+      pfd[n].events = POLLOUT;
+      pfd[n].revents = 0;
+      send_i = n++;
+    }
+    if (rh_off < sizeof(rhdr) || r_off < rn) {
+      pfd[n].fd = recv_fd;
+      pfd[n].events = POLLIN;
+      pfd[n].revents = 0;
+      recv_i = n++;
+    }
+    int64_t left = deadline_ms - now_ms();
+    if (left <= 0) {
+      *timed_out = true;
+      *err = "hop deadline exceeded";
+      return false;
+    }
+    int pr = ::poll(pfd, n, (int)(left > 200 ? 200 : left));
+    if (closed_.load()) {
+      *err = "dataplane shut down";
+      return false;
+    }
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      *err = std::string("poll: ") + strerror(errno);
+      return false;
+    }
+    if (send_i >= 0 && (pfd[send_i].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      // header first, then payload
+      while (sh_off < sizeof(shdr)) {
+        ssize_t k = ::send(send_fd, (const uint8_t*)&shdr + sh_off,
+                           sizeof(shdr) - sh_off, MSG_NOSIGNAL);
+        if (k > 0) {
+          sh_off += (size_t)k;
+        } else if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          break;
+        } else {
+          *send_failed = true;
+          *err = std::string("send: ") + (k == 0 ? "closed" : strerror(errno));
+          return false;
+        }
+      }
+      while (sh_off == sizeof(shdr) && s_off < sn) {
+        ssize_t k = ::send(send_fd, sbuf + s_off, sn - s_off, MSG_NOSIGNAL);
+        if (k > 0) {
+          s_off += (size_t)k;
+        } else if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          break;
+        } else {
+          *send_failed = true;
+          *err = std::string("send: ") + (k == 0 ? "closed" : strerror(errno));
+          return false;
+        }
+      }
+    }
+    if (recv_i >= 0 && (pfd[recv_i].revents & (POLLIN | POLLERR | POLLHUP))) {
+      while (rh_off < sizeof(rhdr)) {
+        ssize_t k = ::recv(recv_fd, (uint8_t*)&rhdr + rh_off,
+                           sizeof(rhdr) - rh_off, 0);
+        if (k > 0) {
+          rh_off += (size_t)k;
+          if (rh_off == sizeof(rhdr)) {
+            if (rhdr.tag != tag || rhdr.len != rn) {
+              *err = "stripe frame mismatch: tag " + std::to_string(rhdr.tag) +
+                     "/" + std::to_string(tag) + " len " +
+                     std::to_string(rhdr.len) + "/" + std::to_string(rn);
+              return false;
+            }
+          }
+        } else if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          break;
+        } else {
+          *err = std::string("recv: ") + (k == 0 ? "closed" : strerror(errno));
+          return false;
+        }
+      }
+      while (rh_off == sizeof(rhdr) && r_off < rn) {
+        ssize_t k = ::recv(recv_fd, rbuf + r_off, rn - r_off, 0);
+        if (k > 0) {
+          r_off += (size_t)k;
+        } else if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          break;
+        } else {
+          *err = std::string("recv: ") + (k == 0 ? "closed" : strerror(errno));
+          return false;
+        }
+      }
+    }
+  }
+  return true;
+}
+
+void DataPlane::enable_cma(const std::vector<int64_t>& pids) {
+  peer_pids_ = pids;
+  cma_ = true;
+}
+
+// CMA hop: descriptors and acks ride the stripe socket; the payload is
+// pulled straight from the left neighbor's address space. Message flow per
+// socket direction is clean: descs flow rank→right, acks flow reader→owner
+// (so on my left socket I read descs and write acks; on my right socket I
+// write descs and read acks) — with world=2 both are the same fd and the
+// peer's desc→ack send order keeps the stream unambiguous.
+bool DataPlane::cma_hop(int send_fd, int recv_fd, const uint8_t* sbuf,
+                        size_t sn, uint8_t* rbuf, size_t rn, uint32_t tag,
+                        int64_t deadline_ms, bool* send_failed,
+                        bool* timed_out, std::string* err) {
+  const int left = (rank_ - 1 + world_) % world_;
+  *send_failed = false;
+  CmaDesc mine{tag, (uint32_t)sn, (uint64_t)(uintptr_t)sbuf};
+  if (!send_small(send_fd, &mine, sizeof(mine), deadline_ms, timed_out, err)) {
+    *send_failed = true;
+    return false;
+  }
+  CmaDesc theirs{};
+  if (!recv_small(recv_fd, &theirs, sizeof(theirs), deadline_ms, timed_out,
+                  err)) {
+    return false;
+  }
+  if (theirs.tag != tag || theirs.len != rn) {
+    *err = "cma desc mismatch: tag " + std::to_string(theirs.tag) + "/" +
+           std::to_string(tag) + " len " + std::to_string(theirs.len) + "/" +
+           std::to_string(rn);
+    return false;
+  }
+  size_t off = 0;
+  while (off < rn) {
+    iovec lv{rbuf + off, rn - off};
+    iovec rv{(void*)(uintptr_t)(theirs.addr + off), rn - off};
+    ssize_t k = ::process_vm_readv((pid_t)peer_pids_[left], &lv, 1, &rv, 1, 0);
+    if (k <= 0) {
+      *err = std::string("process_vm_readv: ") +
+             (k == 0 ? "zero read" : strerror(errno));
+      return false;
+    }
+    off += (size_t)k;
+  }
+  uint32_t ack = tag;
+  if (!send_small(recv_fd, &ack, sizeof(ack), deadline_ms, timed_out, err)) {
+    return false;
+  }
+  uint32_t rack = 0;
+  if (!recv_small(send_fd, &rack, sizeof(rack), deadline_ms, timed_out, err)) {
+    *send_failed = true;
+    return false;
+  }
+  if (rack != tag) {
+    *err = "cma ack mismatch";
+    *send_failed = true;
+    return false;
+  }
+  return true;
+}
+
+int DataPlane::run_stripe(int stripe_idx, Job& job, int* bad_peer,
+                          std::string* err) {
+  const int right = (rank_ + 1) % world_;
+  const int left = (rank_ - 1 + world_) % world_;
+  int send_fd = fd_for(right, stripe_idx);
+  int recv_fd = fd_for(left, stripe_idx);
+  if (send_fd < 0 || recv_fd < 0) {
+    *bad_peer = send_fd < 0 ? right : left;
+    *err = "stripe socket missing";
+    return -1;
+  }
+
+  // CMA pulls exact f32 out of the peer's memory — the wire codec is
+  // moot (and the exactness is deterministic: the owner's bytes are
+  // distributed verbatim in the allgather phase)
+  if (cma_) job.wire_bf16 = false;
+
+  float* flat = (float*)job.base;
+  int64_t n = job.nelems;
+  std::vector<int64_t> bounds(world_ + 1);
+  for (int i = 0; i <= world_; ++i) bounds[i] = n * i / world_;
+  auto chunk_ptr = [&](int i) { return flat + bounds[i]; };
+  auto chunk_n = [&](int i) { return (size_t)(bounds[i + 1] - bounds[i]); };
+
+  size_t max_chunk = 0;
+  for (int i = 0; i < world_; ++i) {
+    if (chunk_n(i) > max_chunk) max_chunk = chunk_n(i);
+  }
+  const size_t wire_elt = job.wire_bf16 ? 2 : 4;
+  auto& st = *stripes_[stripe_idx];
+  st.scratch_send.resize(max_chunk * wire_elt);
+  st.scratch_recv.resize(max_chunk * wire_elt);
+
+  auto prep_send = [&](int idx) -> std::pair<const uint8_t*, size_t> {
+    size_t cn = chunk_n(idx);
+    if (job.wire_bf16) {
+      encode_bf16(chunk_ptr(idx), (uint16_t*)st.scratch_send.data(), cn);
+      return {st.scratch_send.data(), cn * 2};
+    }
+    return {(const uint8_t*)chunk_ptr(idx), cn * 4};
+  };
+
+  bool send_failed = false;
+  bool timed_out = false;
+  auto do_hop = [&](const uint8_t* sb, size_t sn, uint8_t* rb, size_t rn) {
+    return cma_ ? cma_hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
+                          job.deadline_ms, &send_failed, &timed_out, err)
+                : hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
+                      job.deadline_ms, &send_failed, &timed_out, err);
+  };
+  // a deadline names NO peer: slow-but-alive must surface as a retryable
+  // timeout, not an eviction-worthy accusation
+  auto fail = [&]() {
+    if (timed_out) {
+      *bad_peer = -1;
+      return -2;
+    }
+    *bad_peer = send_failed ? right : left;
+    return -1;
+  };
+  // reduce-scatter phase
+  for (int step = 0; step < world_ - 1; ++step) {
+    int send_idx = ((rank_ - step) % world_ + world_) % world_;
+    int recv_idx = ((rank_ - step - 1) % world_ + world_) % world_;
+    auto [sb, sn] = prep_send(send_idx);
+    size_t rn = chunk_n(recv_idx) * wire_elt;
+    if (!do_hop(sb, sn, st.scratch_recv.data(), rn)) {
+      return fail();
+    }
+    if (job.wire_bf16) {
+      reduce_from_bf16(chunk_ptr(recv_idx),
+                       (const uint16_t*)st.scratch_recv.data(),
+                       chunk_n(recv_idx), job.op);
+    } else {
+      reduce_f32(chunk_ptr(recv_idx), (const float*)st.scratch_recv.data(),
+                 chunk_n(recv_idx), job.op);
+    }
+  }
+  // deterministic lossy wire: the owner of the fully reduced chunk must
+  // hold the same bf16-rounded value every other rank receives
+  // (collectives.py has the same round-trip — advisor round-3 high)
+  if (job.wire_bf16 && world_ > 1) {
+    int owned = (rank_ + 1) % world_;
+    float* c = chunk_ptr(owned);
+    for (size_t i = 0; i < chunk_n(owned); ++i) {
+      c[i] = bf16_to_f32(f32_to_bf16(c[i]));
+    }
+  }
+  // allgather phase (raw f32 lands straight in the target chunk; only the
+  // bf16 wire needs the decode bounce through scratch)
+  for (int step = 0; step < world_ - 1; ++step) {
+    int send_idx = ((rank_ + 1 - step) % world_ + world_) % world_;
+    int recv_idx = ((rank_ - step) % world_ + world_) % world_;
+    auto [sb, sn] = prep_send(send_idx);
+    float* dst = chunk_ptr(recv_idx);
+    size_t cn = chunk_n(recv_idx);
+    uint8_t* rb = job.wire_bf16 ? st.scratch_recv.data() : (uint8_t*)dst;
+    if (!do_hop(sb, sn, rb, cn * wire_elt)) {
+      return fail();
+    }
+    if (job.wire_bf16) {
+      const uint16_t* in = (const uint16_t*)st.scratch_recv.data();
+      for (size_t i = 0; i < cn; ++i) dst[i] = bf16_to_f32(in[i]);
+    }
+  }
+  if (job.op == DpOp::kAvg) {
+    float inv = 1.0f / (float)world_;
+    for (int64_t i = 0; i < n; ++i) flat[i] *= inv;
+  }
+  return 0;
+}
+
+void DataPlane::worker_loop(int stripe_idx) {
+  auto& st = *stripes_[stripe_idx];
+  for (;;) {
+    Job job;
+    {
+      std::unique_lock<std::mutex> g(st.mu);
+      st.cv.wait(g, [&] { return st.has_job || closed_.load(); });
+      if (closed_.load()) return;
+      job = st.job;
+      st.has_job = false;
+    }
+    int bad_peer = -1;
+    std::string err;
+    int rc = job.nelems > 0 ? run_stripe(stripe_idx, job, &bad_peer, &err) : 0;
+    {
+      std::lock_guard<std::mutex> g(st.mu);
+      st.rc = rc;
+      st.bad_peer = bad_peer;
+      st.err = err;
+      st.done = true;
+      st.cv.notify_all();
+    }
+  }
+}
+
+int DataPlane::allreduce(void* data, int64_t nelems, DpDtype dtype, DpOp op,
+                         bool wire_bf16, uint32_t tag, int64_t timeout_ms,
+                         int* bad_peer, std::string* err) {
+  *bad_peer = -1;
+  if (dtype != DpDtype::kF32) {
+    *err = "unsupported dtype";
+    return -1;
+  }
+  if (world_ <= 1 || nelems == 0) return 0;
+  int64_t deadline = now_ms() + timeout_ms;
+  // stripe partition: contiguous, 16-element aligned so reduce loops stay
+  // vectorizable and no stripe's chunk is pathologically small
+  int ns = nstripes_;
+  if (nelems < ns * 64) ns = 1;
+  std::vector<int64_t> sb(ns + 1);
+  for (int s = 0; s <= ns; ++s) {
+    sb[s] = ((nelems * s / ns) / 16) * 16;
+  }
+  sb[ns] = nelems;
+  for (int s = 0; s < ns; ++s) {
+    auto& st = *stripes_[s];
+    std::lock_guard<std::mutex> g(st.mu);
+    st.job.base = (uint8_t*)((float*)data + sb[s]);
+    st.job.nelems = sb[s + 1] - sb[s];
+    st.job.op = op;
+    st.job.wire_bf16 = wire_bf16;
+    st.job.tag = tag + (uint32_t)s;
+    st.job.deadline_ms = deadline;
+    st.has_job = true;
+    st.done = false;
+    st.cv.notify_all();
+  }
+  // aggregate: a concrete socket failure (-1, names a peer) outranks a
+  // bare deadline (-2) from another stripe
+  int rc = 0;
+  for (int s = 0; s < ns; ++s) {
+    auto& st = *stripes_[s];
+    std::unique_lock<std::mutex> g(st.mu);
+    st.cv.wait(g, [&] { return st.done || closed_.load(); });
+    if (!st.done) {
+      if (rc == 0) {
+        *err = "dataplane shut down";
+        rc = -1;
+        *bad_peer = -1;
+      }
+      continue;
+    }
+    if (st.rc != 0 && (rc == 0 || (rc == -2 && st.rc == -1))) {
+      rc = st.rc;
+      *bad_peer = st.bad_peer;
+      *err = st.err;
+    }
+  }
+  return rc;
+}
+
+}  // namespace tft
+
+// ---- C ABI for ctypes ------------------------------------------------------
+
+namespace {
+
+std::mutex g_dp_mu;
+int64_t g_dp_next = 1;
+std::map<int64_t, std::shared_ptr<tft::DataPlane>> g_dps;
+
+std::shared_ptr<tft::DataPlane> dp_get(int64_t h) {
+  std::lock_guard<std::mutex> g(g_dp_mu);
+  auto it = g_dps.find(h);
+  return it == g_dps.end() ? nullptr : it->second;
+}
+
+void dp_set_err(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    strncpy(err, msg.c_str(), (size_t)errlen - 1);
+    err[errlen - 1] = '\0';
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t tft_dp_create(int rank, int world, int nstripes, char* err,
+                      int errlen) {
+  try {
+    auto dp = std::make_shared<tft::DataPlane>(rank, world, nstripes);
+    std::lock_guard<std::mutex> g(g_dp_mu);
+    int64_t h = g_dp_next++;
+    g_dps[h] = std::move(dp);
+    return h;
+  } catch (const std::exception& e) {
+    dp_set_err(err, errlen, e.what());
+    return 0;
+  }
+}
+
+int tft_dp_port(int64_t h) {
+  auto dp = dp_get(h);
+  return dp ? dp->port() : -1;
+}
+
+int tft_dp_connect(int64_t h, int peer, const char* host, int port,
+                   int64_t timeout_ms, char* err, int errlen) {
+  auto dp = dp_get(h);
+  if (!dp) {
+    dp_set_err(err, errlen, "bad handle");
+    return -1;
+  }
+  std::string e;
+  if (!dp->connect_peer(peer, host, port, timeout_ms, &e)) {
+    dp_set_err(err, errlen, e);
+    return -1;
+  }
+  return 0;
+}
+
+int tft_dp_wait_ready(int64_t h, int64_t timeout_ms, char* err, int errlen) {
+  auto dp = dp_get(h);
+  if (!dp) {
+    dp_set_err(err, errlen, "bad handle");
+    return -1;
+  }
+  std::string e;
+  if (!dp->wait_ready(timeout_ms, &e)) {
+    dp_set_err(err, errlen, e);
+    return -1;
+  }
+  return 0;
+}
+
+int tft_dp_enable_cma(int64_t h, const int64_t* pids, int n, char* err,
+                      int errlen) {
+  auto dp = dp_get(h);
+  if (!dp) {
+    dp_set_err(err, errlen, "bad handle");
+    return -1;
+  }
+  dp->enable_cma(std::vector<int64_t>(pids, pids + n));
+  return 0;
+}
+
+int tft_dp_allreduce(int64_t h, void* data, int64_t nelems, int dtype, int op,
+                     int wire_bf16, uint32_t tag, int64_t timeout_ms,
+                     int* bad_peer, char* err, int errlen) {
+  auto dp = dp_get(h);
+  if (!dp) {
+    dp_set_err(err, errlen, "bad handle");
+    return -1;
+  }
+  std::string e;
+  int bp = -1;
+  int rc = dp->allreduce(data, nelems, (tft::DpDtype)dtype, (tft::DpOp)op,
+                         wire_bf16 != 0, tag, timeout_ms, &bp, &e);
+  if (bad_peer) *bad_peer = bp;
+  if (rc != 0) dp_set_err(err, errlen, e);
+  return rc;
+}
+
+void tft_dp_free(int64_t h) {
+  std::shared_ptr<tft::DataPlane> dp;
+  {
+    std::lock_guard<std::mutex> g(g_dp_mu);
+    auto it = g_dps.find(h);
+    if (it == g_dps.end()) return;
+    dp = std::move(it->second);
+    g_dps.erase(it);
+  }
+  dp->shutdown();
+}
+
+}  // extern "C"

@@ -1,0 +1,136 @@
+// torchft_tpu native core — striped cross-process gradient data plane.
+//
+// The role NCCL plays for the reference's cross-replica-group gradient
+// averaging (/root/reference/torchft/process_group.py:431-447): a
+// line-rate, GIL-free allreduce between OS processes. Python's TCP ring
+// (torchft_tpu/collectives.py) tops out well under loopback line rate —
+// every hop pays Python thread creation, GIL handoffs, and interpreted
+// framing — so the HOT DATA PATH lives here: persistent per-stripe worker
+// threads drive a ring allreduce over N parallel sockets per peer with
+// nonblocking full-duplex pumps, f32 accumulate, and optional bf16 wire
+// encoding, all without touching the interpreter. Rendezvous, epochs,
+// tags and fallback ops stay in Python (collectives.py) — this plane is
+// reconfigured by constructing a fresh instance per quorum epoch.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace tft {
+
+// element dtypes on the local buffer
+enum class DpDtype : int { kF32 = 0 };
+// reduce ops (AVG divides after the allgather phase)
+enum class DpOp : int { kSum = 0, kAvg = 1, kMax = 2, kMin = 3 };
+
+class DataPlane {
+ public:
+  // Listens on an ephemeral port and starts the acceptor + stripe workers.
+  // Throws std::runtime_error on bind failure.
+  DataPlane(int rank, int world, int nstripes);
+  ~DataPlane();
+
+  DataPlane(const DataPlane&) = delete;
+  DataPlane& operator=(const DataPlane&) = delete;
+
+  int port() const { return port_; }
+
+  // Dial all stripe sockets to a lower-ranked peer (higher ranks dial
+  // lower, mirroring the Python plane's convention). Returns false + err.
+  bool connect_peer(int peer, const std::string& host, int port,
+                    int64_t timeout_ms, std::string* err);
+
+  // Block until every peer has all nstripes sockets established.
+  bool wait_ready(int64_t timeout_ms, std::string* err);
+
+  // Switch payload transport to cross-memory attach (process_vm_readv):
+  // ring hops exchange tiny {tag,len,addr} descriptors + acks over the
+  // stripe sockets and pull the payload straight out of the left
+  // neighbor's address space — one copy at memcpy speed, no loopback-TCP
+  // syscall tax. Caller (Python rendezvous) must have verified every rank
+  // is same-host and CMA-capable (token-checked probe); pids is indexed
+  // by ring rank. The wire codec is bypassed (payloads stay exact f32 —
+  // deterministic since the chunk owner's bytes are distributed verbatim).
+  void enable_cma(const std::vector<int64_t>& pids);
+
+  // In-place ring allreduce of nelems f32 starting at data. Blocking;
+  // returns 0 on success, -1 on socket failure with *bad_peer set to the
+  // ring rank whose socket failed (or -1 if indeterminate), or -2 on
+  // DEADLINE with *bad_peer = -1 — a slow-but-alive peer must surface as
+  // a retryable timeout, never as an eviction-worthy accusation (the
+  // Python mesh draws the same line).
+  int allreduce(void* data, int64_t nelems, DpDtype dtype, DpOp op,
+                bool wire_bf16, uint32_t tag, int64_t timeout_ms,
+                int* bad_peer, std::string* err);
+
+  void shutdown();
+
+ private:
+  struct Job {
+    uint8_t* base = nullptr;   // stripe start
+    int64_t nelems = 0;        // stripe elements
+    DpOp op = DpOp::kSum;
+    bool wire_bf16 = false;
+    uint32_t tag = 0;
+    int64_t deadline_ms = 0;  // absolute, now_ms() clock
+  };
+  struct Stripe {
+    std::thread worker;
+    std::mutex mu;
+    std::condition_variable cv;
+    bool has_job = false;
+    bool done = false;
+    Job job;
+    int rc = 0;
+    int bad_peer = -1;
+    std::string err;
+    std::vector<uint8_t> scratch_send;  // wire-encoded outgoing chunk
+    std::vector<uint8_t> scratch_recv;  // wire-encoded incoming chunk
+  };
+
+  void accept_loop();
+  void hello_handshake(int fd);
+  void worker_loop(int stripe_idx);
+  int run_stripe(int stripe_idx, Job& job, int* bad_peer, std::string* err);
+  bool hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
+           uint8_t* rbuf, size_t rn, uint32_t tag, int64_t deadline_ms,
+           bool* send_failed, bool* timed_out, std::string* err);
+  bool cma_hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
+               uint8_t* rbuf, size_t rn, uint32_t tag, int64_t deadline_ms,
+               bool* send_failed, bool* timed_out, std::string* err);
+  int fd_for(int peer, int stripe);
+
+  int rank_;
+  int world_;
+  int nstripes_;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::thread acceptor_;
+  std::atomic<bool> closed_{false};
+
+  std::mutex socks_mu_;
+  std::condition_variable socks_cv_;
+  // socks_[peer][stripe] = fd (or -1)
+  std::map<int, std::vector<int>> socks_;
+
+  std::vector<std::unique_ptr<Stripe>> stripes_;
+
+  bool cma_ = false;
+  std::vector<int64_t> peer_pids_;  // indexed by ring rank
+
+  // hello handshakes run off the accept thread so one stalled dial can't
+  // starve every other peer's stripe connections during rendezvous
+  std::mutex hello_mu_;
+  std::vector<std::thread> hello_threads_;
+  std::set<int> hello_fds_;  // in-flight, shut down on close
+};
+
+}  // namespace tft

@@ -529,3 +529,98 @@ class TestWrappers:
         res = wrap.allreduce([a]).wait()
         np.testing.assert_allclose(res[0], 1.0)
         wrap.shutdown()
+
+
+class TestNativePlane:
+    """Round-4 native data plane (native/dataplane.cc): the NCCL-role
+    striped C++ ring with one-copy CMA pulls for same-host peers. The
+    default fixture path already exercises CMA (in-process ranks share a
+    pid); these pin down the forced-TCP mode, routing introspection,
+    bitwise bf16 on the striped wire, and peer-death attribution."""
+
+    def test_plane_info_modes(self, store, monkeypatch):
+        def fn(c, rank):
+            return c.plane_info()
+
+        assert set(_run_world(store, 2, fn, prefix="pi1")) == {"cma"}
+        monkeypatch.setenv("TORCHFT_DP_CMA", "0")
+        assert set(_run_world(store, 2, fn, prefix="pi2")) == {"tcp-striped"}
+        assert set(
+            _run_world(store, 2, fn, prefix="pi3", native_plane=False)
+        ) == {"python-ring"}
+
+    @pytest.mark.parametrize("world", [2, 3])
+    def test_tcp_striped_matches_python_ring(self, store, monkeypatch, world):
+        monkeypatch.setenv("TORCHFT_DP_CMA", "0")
+
+        def fn(c, rank):
+            assert c.plane_info() == "tcp-striped"
+            rng = np.random.default_rng(5 + rank)
+            a = rng.standard_normal(100003).astype(np.float32)
+            b = a.copy()
+            out = c.allreduce([a], ReduceOp.AVG).wait(timedelta(seconds=20))
+            return b, out[0]
+
+        outs = _run_world(store, world, fn, prefix=f"tsm{world}")
+        expect = np.mean([b for b, _ in outs], axis=0)
+        for _, got in outs:
+            np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-6)
+        # all ranks bitwise identical (owner-chunk distribution invariant)
+        for _, got in outs[1:]:
+            np.testing.assert_array_equal(got, outs[0][1])
+
+    def test_tcp_striped_bf16_wire_bitwise(self, store, monkeypatch):
+        monkeypatch.setenv("TORCHFT_DP_CMA", "0")
+
+        def fn(c, rank):
+            rng = np.random.default_rng(23 + rank)
+            a = rng.standard_normal(40961).astype(np.float32)
+            return c.allreduce([a], ReduceOp.SUM).wait(
+                timedelta(seconds=20)
+            )[0]
+
+        outs = _run_world(
+            store, 3, fn, prefix="tsbf", wire_dtype="bfloat16"
+        )
+        for out in outs[1:]:
+            np.testing.assert_array_equal(out, outs[0])
+
+    def test_max_min_ops(self, store):
+        def fn(c, rank):
+            a = np.array([rank, -rank, 7], dtype=np.float32)
+            mx = c.allreduce([a.copy()], ReduceOp.MAX).wait(
+                timedelta(seconds=10)
+            )[0]
+            mn = c.allreduce([a.copy()], ReduceOp.MIN).wait(
+                timedelta(seconds=10)
+            )[0]
+            return mx, mn
+
+        outs = _run_world(store, 3, fn, prefix="mxmn")
+        for mx, mn in outs:
+            np.testing.assert_array_equal(mx, [2.0, 0.0, 7.0])
+            np.testing.assert_array_equal(mn, [0.0, -2.0, 7.0])
+
+    @pytest.mark.parametrize("cma", ["1", "0"])
+    def test_peer_death_attribution(self, store, monkeypatch, cma):
+        """A rank vanishing mid-allreduce surfaces PeerGoneError with the
+        dead ring rank, on both the CMA and striped-TCP transports."""
+        monkeypatch.setenv("TORCHFT_DP_CMA", cma)
+        from torchft_tpu.collectives import PeerGoneError
+
+        def fn(c, rank):
+            if rank == 1:
+                return "died"  # shutdown() in the harness closes sockets
+            a = np.ones(1 << 20, dtype=np.float32)
+            try:
+                c.allreduce([a], ReduceOp.SUM).wait(timedelta(seconds=15))
+                return "completed"
+            except PeerGoneError as e:
+                return ("gone", e.peer_rank)
+            except Exception as e:  # noqa: BLE001
+                return ("other", type(e).__name__, str(e)[:100])
+
+        outs = _run_world(store, 2, fn, prefix=f"pd{cma}")
+        assert outs[1] == "died"
+        assert outs[0][0] == "gone", outs[0]
+        assert outs[0][1] == 1

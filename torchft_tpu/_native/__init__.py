@@ -110,6 +110,29 @@ def _load() -> ctypes.CDLL:
     ]
     lib.tft_compute_quorum_results.restype = c.c_int64
 
+    # striped cross-process gradient data plane (native/dataplane.cc)
+    lib.tft_dp_create.argtypes = [c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_int]
+    lib.tft_dp_create.restype = c.c_int64
+    lib.tft_dp_port.argtypes = [c.c_int64]
+    lib.tft_dp_port.restype = c.c_int
+    lib.tft_dp_connect.argtypes = [
+        c.c_int64, c.c_int, c.c_char_p, c.c_int, c.c_int64, c.c_char_p, c.c_int,
+    ]
+    lib.tft_dp_connect.restype = c.c_int
+    lib.tft_dp_wait_ready.argtypes = [c.c_int64, c.c_int64, c.c_char_p, c.c_int]
+    lib.tft_dp_wait_ready.restype = c.c_int
+    lib.tft_dp_enable_cma.argtypes = [
+        c.c_int64, c.POINTER(c.c_int64), c.c_int, c.c_char_p, c.c_int,
+    ]
+    lib.tft_dp_enable_cma.restype = c.c_int
+    lib.tft_dp_allreduce.argtypes = [
+        c.c_int64, c.c_void_p, c.c_int64, c.c_int, c.c_int, c.c_int,
+        c.c_uint32, c.c_int64, c.POINTER(c.c_int), c.c_char_p, c.c_int,
+    ]
+    lib.tft_dp_allreduce.restype = c.c_int
+    lib.tft_dp_free.argtypes = [c.c_int64]
+    lib.tft_dp_free.restype = None
+
     return lib
 
 
@@ -275,3 +298,120 @@ def compute_quorum_results(
         _lib.tft_compute_quorum_results, wire.encode(quorum),
         replica_id.encode(), rank,
     )
+
+
+class _iovec(ctypes.Structure):
+    _fields_ = [("iov_base", ctypes.c_void_p), ("iov_len", ctypes.c_size_t)]
+
+
+def cma_read(pid: int, addr: int, n: int) -> bytes:
+    """One process_vm_readv of ``n`` bytes from ``pid``'s address space —
+    the rendezvous probe for the CMA transport (a token round-trip proves
+    the published pid is addressable from THIS pid namespace and ptrace
+    policy allows the attach). Raises OSError when the kernel says no."""
+    libc = ctypes.CDLL("libc.so.6", use_errno=True)
+    buf = ctypes.create_string_buffer(n)
+    local = _iovec(ctypes.addressof(buf), n)
+    remote = _iovec(addr, n)
+    got = libc.process_vm_readv(
+        pid, ctypes.byref(local), 1, ctypes.byref(remote), 1, 0
+    )
+    if got != n:
+        raise OSError(ctypes.get_errno(), "process_vm_readv failed")
+    return buf.raw
+
+
+class DataPlaneError(ConnectionError):
+    """Native data-plane op failed; ``peer_rank`` is the ring rank whose
+    socket broke (−1 when indeterminate) for eviction attribution."""
+
+    def __init__(self, peer_rank: int, msg: str) -> None:
+        super().__init__(msg)
+        self.peer_rank = peer_rank
+
+
+class NativeDataPlane:
+    """ctypes wrapper for the striped C++ gradient plane (dataplane.cc).
+
+    One instance per collectives epoch: rendezvous (store addresses,
+    who-dials-whom) stays in Python; the hot allreduce bytes never touch
+    the interpreter (ctypes drops the GIL for the duration of the call).
+    """
+
+    DTYPE_F32 = 0
+    OP = {"sum": 0, "avg": 1, "max": 2, "min": 3}
+
+    def __init__(self, rank: int, world: int, nstripes: int = 4) -> None:
+        err = _errbuf()
+        self._h = _lib.tft_dp_create(rank, world, nstripes, err, _ERRLEN)
+        if self._h == 0:
+            raise RuntimeError(f"dataplane create: {err.value.decode()}")
+        self.rank = rank
+        self.world = world
+        self.nstripes = nstripes
+        self.port = int(_lib.tft_dp_port(self._h))
+
+    def connect(self, peer: int, host: str, port: int, timeout_ms: int) -> None:
+        err = _errbuf()
+        rc = _lib.tft_dp_connect(
+            self._h, peer, host.encode(), port, timeout_ms, err, _ERRLEN
+        )
+        if rc != 0:
+            raise DataPlaneError(
+                peer, f"dataplane dial {peer}: {err.value.decode()}"
+            )
+
+    def wait_ready(self, timeout_ms: int) -> None:
+        err = _errbuf()
+        rc = _lib.tft_dp_wait_ready(self._h, timeout_ms, err, _ERRLEN)
+        if rc != 0:
+            raise TimeoutError(f"dataplane rendezvous: {err.value.decode()}")
+
+    def enable_cma(self, pids: "list[int]") -> None:
+        """Switch ring payloads to cross-memory attach (one-copy pulls
+        from the left neighbor's address space). Caller must have proven
+        all ranks same-host + CMA-capable; ``pids`` indexed by rank."""
+        arr = (ctypes.c_int64 * len(pids))(*pids)
+        err = _errbuf()
+        rc = _lib.tft_dp_enable_cma(self._h, arr, len(pids), err, _ERRLEN)
+        if rc != 0:
+            raise RuntimeError(f"enable_cma: {err.value.decode()}")
+
+    def allreduce(
+        self,
+        ptr: int,
+        nelems: int,
+        op: str,
+        wire_bf16: bool,
+        tag: int,
+        timeout_ms: int,
+    ) -> None:
+        """In-place f32 ring allreduce on the buffer at ``ptr``. Blocking —
+        call from the collectives op thread; the GIL is released."""
+        err = _errbuf()
+        bad_peer = ctypes.c_int(-1)
+        rc = _lib.tft_dp_allreduce(
+            self._h, ptr, nelems, self.DTYPE_F32, self.OP[op],
+            1 if wire_bf16 else 0, tag, timeout_ms,
+            ctypes.byref(bad_peer), err, _ERRLEN,
+        )
+        if rc == -2:
+            # deadline, no peer named: slow-but-alive must be retryable,
+            # never an eviction-worthy accusation
+            raise TimeoutError(f"dataplane allreduce: {err.value.decode()}")
+        if rc != 0:
+            raise DataPlaneError(
+                int(bad_peer.value),
+                f"dataplane allreduce: {err.value.decode()}",
+            )
+
+    def close(self) -> None:
+        if self._h:
+            _lib.tft_dp_free(self._h)
+            self._h = 0
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass

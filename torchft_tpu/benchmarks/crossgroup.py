@@ -47,6 +47,49 @@ LLAMA2_7B_PARAMS = (
 )
 
 
+def _raw_worker_main(argv: List[str]) -> None:
+    """Pure data-plane rate: two processes, one big f32 allreduce, no
+    Manager/quorum/JAX in the loop — isolates what the transport itself
+    moves (the number comparable to a NCCL busbw measurement)."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gid", type=int, required=True)
+    parser.add_argument("--store", required=True)
+    parser.add_argument("--total-mb", type=float, required=True)
+    parser.add_argument("--rounds", type=int, required=True)
+    parser.add_argument("--wire-dtype", default="")
+    args = parser.parse_args(argv)
+
+    import numpy as np
+
+    from torchft_tpu.collectives import CollectivesTcp, ReduceOp
+
+    c = CollectivesTcp(
+        timeout=timedelta(seconds=120),
+        hostname="localhost",
+        wire_dtype=args.wire_dtype or None,
+    )
+    c.configure(args.store, args.gid, 2)
+    n = int(args.total_mb * 1024 * 1024 / 4)
+    arr = np.full(n, float(args.gid + 1), dtype=np.float32)
+    c.allreduce([arr], ReduceOp.AVG).wait(timedelta(seconds=120))  # warmup
+    t0 = time.perf_counter()
+    for _ in range(args.rounds):
+        c.allreduce([arr], ReduceOp.AVG).wait(timedelta(seconds=120))
+    elapsed = (time.perf_counter() - t0) / args.rounds
+    print(
+        json.dumps(
+            {
+                "gid": args.gid,
+                "seconds_per_round": elapsed,
+                "total_bytes": n * 4,
+                "plane": c.plane_info(),
+            }
+        ),
+        flush=True,
+    )
+    c.shutdown()
+
+
 def _worker_main(argv: List[str]) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--gid", type=int, required=True)
@@ -201,6 +244,59 @@ def _run_pair(
     }
 
 
+def _run_raw_pair(
+    total_mb: float, rounds: int, wire_dtype: str, env_extra: Dict[str, str]
+) -> Dict[str, object]:
+    from torchft_tpu.store import StoreServer
+
+    store = StoreServer()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra)
+    procs = []
+    try:
+        for gid in range(2):
+            cmd = [
+                sys.executable,
+                "-m",
+                "torchft_tpu.benchmarks.crossgroup",
+                "--raw-worker",
+                "--gid",
+                str(gid),
+                "--store",
+                store.address(),
+                "--total-mb",
+                str(total_mb),
+                "--rounds",
+                str(rounds),
+                "--wire-dtype",
+                wire_dtype,
+            ]
+            procs.append(
+                subprocess.Popen(
+                    cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env
+                )
+            )
+        results = []
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"raw worker failed rc={p.returncode}: {err.decode()[-2000:]}"
+                )
+            results.append(json.loads(out.decode().strip().splitlines()[-1]))
+    finally:
+        store.shutdown()
+    secs = max(r["seconds_per_round"] for r in results)
+    return {
+        "seconds_per_round": round(secs, 4),
+        "gb_per_sec": round(results[0]["total_bytes"] / secs / 1e9, 3),
+        "total_bytes": results[0]["total_bytes"],
+        "plane": results[0]["plane"],
+    }
+
+
 def measure_crossgroup(
     total_mb: float = 256.0, rounds: int = 3
 ) -> Dict[str, object]:
@@ -208,11 +304,40 @@ def measure_crossgroup(
     from torchft_tpu.coordination import LighthouseServer
 
     out: Dict[str, object] = {
-        "topology": "2 replica groups, separate OS processes, TCP ring "
-        "(DCN analogue) through full Manager quorum+commit",
+        "topology": "2 replica groups, separate OS processes, native "
+        "striped data plane (CMA same-host / multi-socket TCP), e2e "
+        "variants through full Manager quorum+commit",
         "tree_mb": total_mb,
     }
     grad_bytes_7b = LLAMA2_7B_PARAMS * 4  # f32 gradient tree
+
+    # RAW transport matrix: what the plane itself moves (busbw analogue).
+    # CMA = one-copy process_vm_readv pulls (same-host; NCCL SHM/P2P
+    # analogue); tcp-striped = the cross-host path, forced here via env;
+    # python-ring = the pre-round-4 interpreter path, kept for comparison.
+    raw_variants = {
+        "raw_cma": dict(wire_dtype="", env_extra={}),
+        "raw_tcp_striped": dict(
+            wire_dtype="", env_extra={"TORCHFT_DP_CMA": "0"}
+        ),
+        "raw_tcp_striped_bf16": dict(
+            wire_dtype="bfloat16", env_extra={"TORCHFT_DP_CMA": "0"}
+        ),
+        "raw_python_ring": dict(
+            wire_dtype="", env_extra={"TORCHFT_NATIVE_PLANE": "0"}
+        ),
+    }
+    for name, kw in raw_variants.items():
+        try:
+            res = _run_raw_pair(total_mb, rounds, **kw)  # type: ignore[arg-type]
+        except Exception as e:  # noqa: BLE001 — best-effort matrix row
+            out[name] = {"error": str(e)}
+            continue
+        res["derived_llama2_7b_avg_s"] = round(
+            grad_bytes_7b * res["seconds_per_round"] / res["total_bytes"], 2
+        )
+        del res["total_bytes"]
+        out[name] = res
 
     variants = {
         "serial_r2": dict(wire_dtype="", serial=True),
@@ -239,14 +364,20 @@ def measure_crossgroup(
     pipe = out["pipelined"]["seconds_per_round"]  # type: ignore[index]
     out["pipeline_speedup"] = round(ser / pipe, 3) if pipe else None
     out["note"] = (
-        "derived_llama2_7b_avg_s extrapolates measured bytes/s to the 7B "
-        "preset's f32 gradient tree (bf16 wire halves DCN bytes); workers "
-        "run on CPU so the wire path is measured without occupying the chip"
+        "raw_* rows isolate the transport (one allreduce, no Manager); "
+        "e2e rows include full per-round quorum+commit and JAX<->host "
+        "copies. derived_llama2_7b_avg_s extrapolates measured bytes/s to "
+        "the 7B preset's f32 gradient tree; workers run on CPU so the "
+        "wire path is measured without occupying the chip"
     )
     return out
 
 
 def main() -> None:
+    if "--raw-worker" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--raw-worker"]
+        _raw_worker_main(argv)
+        return
     if "--worker" in sys.argv:
         argv = [a for a in sys.argv[1:] if a != "--worker"]
         _worker_main(argv)

@@ -245,6 +245,8 @@ class CollectivesTcp(Collectives):
         wire_dtype: Optional[str] = None,
         p2p_workers: int = 8,
         stash_limit: int = 1 << 30,
+        native_plane: Optional[bool] = None,
+        dp_stripes: Optional[int] = None,
     ) -> None:
         """
         Args:
@@ -259,7 +261,25 @@ class CollectivesTcp(Collectives):
                 safe (:meth:`_recv_matched`).
             stash_limit: byte cap on frames parked for tags no local op is
                 consuming — the desync tripwire.
+            native_plane: route large f32 allreduces through the striped
+                C++ data plane (native/dataplane.cc) — the NCCL-role fast
+                path (process_group.py:431-447): GIL-free, N sockets per
+                peer, wire codec in C++. Default on; override with env
+                ``TORCHFT_NATIVE_PLANE=0``. MUST agree across ranks (a
+                split group would wait on different sockets), so setup
+                failures raise instead of falling back.
+            dp_stripes: sockets per peer for the native plane (default 4,
+                env ``TORCHFT_DP_STRIPES``).
         """
+        import os as _os
+
+        if native_plane is None:
+            native_plane = _os.environ.get("TORCHFT_NATIVE_PLANE", "1") != "0"
+        if dp_stripes is None:
+            dp_stripes = int(_os.environ.get("TORCHFT_DP_STRIPES", "4"))
+        self._native_plane = native_plane
+        self._dp_stripes = max(1, dp_stripes)
+        self._dp = None  # NativeDataPlane for the current epoch
         self._timeout = timeout
         self._hostname = hostname or socket.gethostname()
         if wire_dtype:
@@ -335,6 +355,94 @@ class CollectivesTcp(Collectives):
                 self._dial(peer, deadline)
         # Wait for all higher ranks to dial us.
         self._wait_for_peers(set(range(rank + 1, world_size)))
+        if self._native_plane:
+            self._configure_dp(rank, world_size)
+
+    def _configure_dp(self, rank: int, world_size: int) -> None:
+        """Stand up the striped C++ gradient plane for this epoch. Same
+        rendezvous shape as the Python mesh (store-published listeners,
+        higher ranks dial lower); failures RAISE — every rank must land on
+        the same plane or the group deadlocks across planes."""
+        from torchft_tpu._native import NativeDataPlane
+
+        timeout_ms = int(self._timeout.total_seconds() * 1000)
+        dp = NativeDataPlane(rank, world_size, self._dp_stripes)
+        self._dp_cma = False
+        try:
+            self._store.set(f"coll/dpaddr/{rank}", f"{self._hostname}:{dp.port}")
+            for peer in range(rank):
+                addr = self._store.get(
+                    f"coll/dpaddr/{peer}", timeout=self._timeout
+                ).decode()
+                host, port = addr.rsplit(":", 1)
+                dp.connect(peer, host, int(port), timeout_ms)
+            dp.wait_ready(timeout_ms)
+            self._maybe_enable_cma(dp, rank, world_size)
+        except BaseException:
+            dp.close()
+            raise
+        self._dp = dp
+
+    def _maybe_enable_cma(self, dp, rank: int, world_size: int) -> None:
+        """Negotiate the one-copy CMA transport (process_vm_readv pulls —
+        the NCCL intra-node SHM/P2P analogue). Every rank probes its LEFT
+        ring neighbor with a token read (proving same pid namespace +
+        ptrace policy, not just same hostname) and publishes the result;
+        the mode flips on only when ALL ranks proved their read, keeping
+        the ring homogeneous — a mixed ring would deadlock or, with bf16
+        wire, break bitwise determinism. Opt out: TORCHFT_DP_CMA=0."""
+        import ctypes as ct
+        import os
+        import secrets
+
+        if os.environ.get("TORCHFT_DP_CMA", "1") == "0":
+            return
+        from torchft_tpu._native import cma_read
+
+        token = secrets.token_bytes(16)
+        # keep the probe target alive for the epoch (peers read it remotely)
+        self._dp_probe_buf = ct.create_string_buffer(token, 16)
+        self._store.set(
+            f"coll/dpcma/{rank}",
+            f"{self._hostname}|{os.getpid()}|{token.hex()}"
+            f"|{ct.addressof(self._dp_probe_buf)}",
+        )
+        left = (rank - 1) % world_size
+        ok = False
+        try:
+            ent = self._store.get(
+                f"coll/dpcma/{left}", timeout=self._timeout
+            ).decode()
+            lhost, lpid, ltok, laddr = ent.split("|")
+            if lhost == self._hostname:
+                ok = cma_read(int(lpid), int(laddr), 16) == bytes.fromhex(ltok)
+        except Exception as e:  # noqa: BLE001 — any failure means TCP
+            logger.info("CMA probe of rank %d failed (%s); staying on TCP", left, e)
+        self._store.set(f"coll/dpcmaok/{rank}", "1" if ok else "0")
+        pids = []
+        all_ok = True
+        for p in range(world_size):
+            flag = self._store.get(
+                f"coll/dpcmaok/{p}", timeout=self._timeout
+            ).decode()
+            ent = self._store.get(f"coll/dpcma/{p}", timeout=self._timeout).decode()
+            pids.append(int(ent.split("|")[1]))
+            all_ok = all_ok and flag == "1"
+        if all_ok:
+            dp.enable_cma(pids)
+            self._dp_cma = True
+            logger.info(
+                "data plane: CMA transport enabled (%d ranks, one host)",
+                world_size,
+            )
+
+    def plane_info(self) -> str:
+        """Which transport carries large f32 allreduces this epoch:
+        ``"cma"`` (one-copy process_vm_readv pulls), ``"tcp-striped"``
+        (C++ multi-socket ring) or ``"python-ring"`` (fallback)."""
+        if self._dp is None:
+            return "python-ring"
+        return "cma" if getattr(self, "_dp_cma", False) else "tcp-striped"
 
     def _wait_for_peers(self, expected: set) -> None:
         import time
@@ -417,6 +525,11 @@ class CollectivesTcp(Collectives):
                 except OSError:
                     pass
             self._peers.clear()
+        if self._dp is not None:
+            # before joining the executor: closing the plane's sockets
+            # unblocks an op thread parked inside the native allreduce
+            self._dp.close()
+            self._dp = None
         if self._executor is not None:
             self._executor.shutdown(wait=True, cancel_futures=True)
             self._executor = None
@@ -643,14 +756,55 @@ class CollectivesTcp(Collectives):
 
         def run() -> List[np.ndarray]:
             if world > 1:
+                # ops are serialized on the op thread, so arrays of one
+                # allreduce may share the tag (it is a desync check, not a
+                # demultiplexer; the native plane offsets per-stripe)
                 for arr in arrays:
-                    self._ring_allreduce(arr, op, tag)
-            if op == ReduceOp.AVG:
-                for arr in arrays:
-                    np.divide(arr, world, out=arr)
+                    if self._dp_eligible(arr):
+                        self._dp_allreduce(arr, op, tag)
+                    else:
+                        self._ring_allreduce(arr, op, tag)
+                        if op == ReduceOp.AVG:
+                            np.divide(arr, world, out=arr)
             return arrays
 
         return self._submit(run)
+
+    def _dp_eligible(self, arr: np.ndarray) -> bool:
+        # wire_dtype other than bfloat16 isn't implemented natively; such
+        # configs keep the Python ring so the compression contract holds
+        return (
+            self._dp is not None
+            and arr.dtype == np.float32
+            and arr.flags["C_CONTIGUOUS"]
+            and (self._wire_dtype is None or self._wire_dtype.name == "bfloat16")
+        )
+
+    def _dp_allreduce(self, arr: np.ndarray, op: ReduceOp, tag: int) -> None:
+        """Hot path: the striped C++ ring (AVG divides natively; bf16 wire
+        when wire_dtype is bfloat16, with the same deterministic owner
+        round-trip as the Python ring)."""
+        from torchft_tpu._native import DataPlaneError
+
+        wire_bf16 = (
+            self._wire_dtype is not None and self._wire_dtype.name == "bfloat16"
+        )
+        dp = self._dp  # teardown may None the field mid-op
+        if dp is None:
+            raise RuntimeError("data plane torn down")
+        try:
+            dp.allreduce(
+                arr.ctypes.data,
+                arr.size,
+                op.value,
+                wire_bf16,
+                tag,
+                int(self._timeout.total_seconds() * 1000),
+            )
+        except DataPlaneError as e:
+            if e.peer_rank >= 0:
+                raise PeerGoneError(e.peer_rank, str(e)) from e
+            raise
 
     def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp, tag: int) -> None:
         world, rank = self._world, self._rank
