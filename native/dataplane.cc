@@ -230,8 +230,9 @@ void DataPlane::shutdown() {
     {
       std::lock_guard<std::mutex> g(hello_mu_);
       if (hello_threads_.empty()) break;
-      t = std::move(hello_threads_.back());
-      hello_threads_.pop_back();
+      auto it = hello_threads_.begin();
+      t = std::move(it->second);
+      hello_threads_.erase(it);
     }
     if (t.joinable()) t.join();
   }
@@ -257,18 +258,35 @@ void DataPlane::accept_loop() {
     }
     // hello runs on its own short-lived thread: one stalled or garbage
     // connection must not starve the other world*nstripes dials of the
-    // rendezvous window (round-4 review finding)
-    std::lock_guard<std::mutex> g(hello_mu_);
-    if (closed_.load()) {
-      ::close(fd);
-      return;
+    // rendezvous window. Finished threads are reaped here so a long-lived
+    // plane poked by scanners/redials doesn't grow thread objects forever.
+    std::vector<std::thread> reap;
+    {
+      std::lock_guard<std::mutex> g(hello_mu_);
+      if (closed_.load()) {
+        ::close(fd);
+        return;
+      }
+      for (uint64_t id : hello_finished_) {
+        auto it = hello_threads_.find(id);
+        if (it != hello_threads_.end()) {
+          reap.push_back(std::move(it->second));
+          hello_threads_.erase(it);
+        }
+      }
+      hello_finished_.clear();
+      uint64_t id = next_hello_id_++;
+      hello_fds_.insert(fd);
+      hello_threads_.emplace(
+          id, std::thread([this, fd, id] { hello_handshake(fd, id); }));
     }
-    hello_fds_.insert(fd);
-    hello_threads_.emplace_back([this, fd] { hello_handshake(fd); });
+    for (auto& t : reap) {
+      if (t.joinable()) t.join();
+    }
   }
 }
 
-void DataPlane::hello_handshake(int fd) {
+void DataPlane::hello_handshake(int fd, uint64_t id) {
   // hello: {magic, rank, stripe} — bounded read
   uint32_t hello[3];
   bool ok = read_exact(fd, hello, sizeof(hello), now_ms() + 10000) &&
@@ -278,6 +296,7 @@ void DataPlane::hello_handshake(int fd) {
   {
     std::lock_guard<std::mutex> g(hello_mu_);
     hello_fds_.erase(fd);
+    hello_finished_.push_back(id);
   }
   if (!ok || peer < 0 || peer >= world_ || stripe < 0 ||
       stripe >= nstripes_) {
@@ -300,8 +319,16 @@ void DataPlane::hello_handshake(int fd) {
 
 bool DataPlane::connect_peer(int peer, const std::string& host, int port,
                              int64_t timeout_ms, std::string* err) {
+  // ONE deadline across all stripes — an unreachable peer must cost one
+  // timeout budget, not nstripes of them
+  int64_t deadline = now_ms() + timeout_ms;
   for (int s = 0; s < nstripes_; ++s) {
-    int fd = tcp_connect(host, port, timeout_ms, err);
+    int64_t left = deadline - now_ms();
+    if (left <= 0) {
+      *err = "connect deadline exceeded";
+      return false;
+    }
+    int fd = tcp_connect(host, port, left, err);
     if (fd < 0) return false;
     uint32_t hello[3] = {kHelloMagic, (uint32_t)rank_, (uint32_t)s};
     if (!write_all(fd, hello, sizeof(hello))) {
@@ -584,12 +611,17 @@ int DataPlane::run_stripe(int stripe_idx, Job& job, int* bad_peer,
                 : hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
                       job.deadline_ms, &send_failed, &timed_out, err);
   };
-  // a deadline names NO peer: slow-but-alive must surface as a retryable
-  // timeout, not an eviction-worthy accusation
+  // a deadline or LOCAL shutdown names NO peer: slow-but-alive (or our
+  // own teardown) must surface as retryable, not as an eviction-worthy
+  // accusation against an innocent neighbor
   auto fail = [&]() {
     if (timed_out) {
       *bad_peer = -1;
       return -2;
+    }
+    if (closed_.load()) {
+      *bad_peer = -1;
+      return -1;
     }
     *bad_peer = send_failed ? right : left;
     return -1;

@@ -97,7 +97,7 @@ class DataPlane {
   };
 
   void accept_loop();
-  void hello_handshake(int fd);
+  void hello_handshake(int fd, uint64_t id);
   void worker_loop(int stripe_idx);
   int run_stripe(int stripe_idx, Job& job, int* bad_peer, std::string* err);
   bool hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
@@ -127,9 +127,12 @@ class DataPlane {
   std::vector<int64_t> peer_pids_;  // indexed by ring rank
 
   // hello handshakes run off the accept thread so one stalled dial can't
-  // starve every other peer's stripe connections during rendezvous
+  // starve every other peer's stripe connections during rendezvous;
+  // finished threads announce their id and the accept loop reaps them
   std::mutex hello_mu_;
-  std::vector<std::thread> hello_threads_;
+  std::map<uint64_t, std::thread> hello_threads_;
+  std::vector<uint64_t> hello_finished_;
+  uint64_t next_hello_id_ = 0;
   std::set<int> hello_fds_;  // in-flight, shut down on close
 };
 

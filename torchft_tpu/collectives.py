@@ -365,25 +365,40 @@ class CollectivesTcp(Collectives):
         the same plane or the group deadlocks across planes."""
         from torchft_tpu._native import NativeDataPlane
 
-        timeout_ms = int(self._timeout.total_seconds() * 1000)
+        import time as _time
+
+        # ONE deadline for the whole data-plane rendezvous (store gets,
+        # every peer's stripe dials, readiness, CMA negotiation): an
+        # unreachable peer must cost one timeout budget, not
+        # world × nstripes of them
+        deadline = _time.monotonic() + self._timeout.total_seconds()
+
+        def left() -> timedelta:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("data-plane rendezvous deadline exceeded")
+            return timedelta(seconds=remaining)
+
         dp = NativeDataPlane(rank, world_size, self._dp_stripes)
         self._dp_cma = False
         try:
             self._store.set(f"coll/dpaddr/{rank}", f"{self._hostname}:{dp.port}")
             for peer in range(rank):
                 addr = self._store.get(
-                    f"coll/dpaddr/{peer}", timeout=self._timeout
+                    f"coll/dpaddr/{peer}", timeout=left()
                 ).decode()
                 host, port = addr.rsplit(":", 1)
-                dp.connect(peer, host, int(port), timeout_ms)
-            dp.wait_ready(timeout_ms)
-            self._maybe_enable_cma(dp, rank, world_size)
+                dp.connect(
+                    peer, host, int(port), int(left().total_seconds() * 1000)
+                )
+            dp.wait_ready(int(left().total_seconds() * 1000))
+            self._maybe_enable_cma(dp, rank, world_size, left)
         except BaseException:
             dp.close()
             raise
         self._dp = dp
 
-    def _maybe_enable_cma(self, dp, rank: int, world_size: int) -> None:
+    def _maybe_enable_cma(self, dp, rank: int, world_size: int, remaining) -> None:
         """Negotiate the one-copy CMA transport (process_vm_readv pulls —
         the NCCL intra-node SHM/P2P analogue). Every rank probes its LEFT
         ring neighbor with a token read (proving same pid namespace +
@@ -411,7 +426,7 @@ class CollectivesTcp(Collectives):
         ok = False
         try:
             ent = self._store.get(
-                f"coll/dpcma/{left}", timeout=self._timeout
+                f"coll/dpcma/{left}", timeout=remaining()
             ).decode()
             lhost, lpid, ltok, laddr = ent.split("|")
             if lhost == self._hostname:
@@ -423,9 +438,11 @@ class CollectivesTcp(Collectives):
         all_ok = True
         for p in range(world_size):
             flag = self._store.get(
-                f"coll/dpcmaok/{p}", timeout=self._timeout
+                f"coll/dpcmaok/{p}", timeout=remaining()
             ).decode()
-            ent = self._store.get(f"coll/dpcma/{p}", timeout=self._timeout).decode()
+            ent = self._store.get(
+                f"coll/dpcma/{p}", timeout=remaining()
+            ).decode()
             pids.append(int(ent.split("|")[1]))
             all_ok = all_ok and flag == "1"
         if all_ok:
