@@ -46,3 +46,24 @@ def test_recovery_1of4_north_star_shape():
     )
     assert r.survivor_blackout_s < 6.0, r
     assert r.rejoin_to_commit_s < 20.0, r
+
+
+def test_recovery_1of4_one_step_envelope():
+    """Round-4: with the death watch (socket-FIN-driven evict + early
+    re-quorum overlapping the doomed step), killing 1-of-4 groups must
+    cost the survivors at most ONE committed step (the reference's
+    product promise, README.md:29-47). The bench box can be contended, so
+    accept <=1 after one retry rather than demanding the usual 0."""
+    for attempt in range(2):
+        r = measure_recovery(
+            total_steps=25,
+            kill_at_step=6,
+            step_sleep=0.05,
+            op_timeout=1.0,
+            heartbeat_timeout_ms=1000,
+            timeout_s=120.0,
+            num_groups=4,
+        )
+        if r.survivor_steps_lost <= 1:
+            return
+    assert r.survivor_steps_lost <= 1, r.as_dict()

@@ -68,6 +68,15 @@ def _worker() -> None:
     from torchft_tpu.collectives import CollectivesTcp
     from torchft_tpu.manager import Manager
 
+    if os.environ.get("TORCHFT_BENCH_DEBUG"):
+        import logging
+
+        logging.basicConfig(
+            level=logging.DEBUG,
+            format="%(asctime)s.%(msecs)03d %(name)s: %(message)s",
+            datefmt="%H:%M:%S",
+        )
+
     gid = int(os.environ["REPLICA_GROUP_ID"])
     total_steps = int(os.environ["TORCHFT_BENCH_STEPS"])
     step_sleep = float(os.environ.get("TORCHFT_BENCH_STEP_SLEEP", "0.05"))
@@ -178,12 +187,20 @@ def _spawn(
         # keep children off any accelerator the parent owns
         JAX_PLATFORMS="cpu",
     )
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "torchft_tpu.benchmarks.recovery"],
-        env=env,
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-    )
+    if os.environ.get("TORCHFT_BENCH_DEBUG"):
+        stderr_f = open(env["TORCHFT_EVENT_LOG"] + ".stderr", "ab")
+    else:
+        stderr_f = subprocess.DEVNULL
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "torchft_tpu.benchmarks.recovery"],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=stderr_f,
+        )
+    finally:
+        if stderr_f is not subprocess.DEVNULL:
+            stderr_f.close()  # the child keeps its inherited copy
     proc._torchft_store = store  # keep the store alive with the proc
     return proc
 

@@ -280,6 +280,7 @@ class CollectivesTcp(Collectives):
         self._native_plane = native_plane
         self._dp_stripes = max(1, dp_stripes)
         self._dp = None  # NativeDataPlane for the current epoch
+        self._death_watch_cb: Optional[Callable[[int], None]] = None
         self._timeout = timeout
         self._hostname = hostname or socket.gethostname()
         if wire_dtype:
@@ -357,6 +358,75 @@ class CollectivesTcp(Collectives):
         self._wait_for_peers(set(range(rank + 1, world_size)))
         if self._native_plane:
             self._configure_dp(rank, world_size)
+        if self._death_watch_cb is not None:
+            threading.Thread(
+                target=self._death_watch_loop,
+                args=(gen,),
+                daemon=True,
+                name="tft_death_watch",
+            ).start()
+
+    def set_death_watch(self, cb: Callable[[int], None]) -> None:
+        """Register a peer-death callback (called with the ring rank whose
+        socket hit EOF/error). Armed at the NEXT configure(). This is the
+        active failure detector: a SIGKILLed peer's FIN reaches every
+        survivor within milliseconds, long before their next collective op
+        touches the socket — the callback lets the Manager evict and
+        re-quorum DURING the doomed step instead of at its own step
+        boundary. False positives (a peer tearing down an old epoch early)
+        are safe: eviction is liveness-probe-guarded at the lighthouse."""
+        self._death_watch_cb = cb
+
+    def _death_watch_loop(self, gen: int) -> None:
+        import select
+
+        poll_rdhup = getattr(select, "POLLRDHUP", 0x2000)
+        poller = select.poll()
+        with self._peers_lock:
+            if gen != self._generation:
+                return
+            fds = {}
+            for r, p in self._peers.items():
+                try:
+                    fd = p.sock.fileno()
+                except OSError:
+                    continue
+                fds[fd] = r
+        for fd in fds:
+            poller.register(fd, select.POLLERR | select.POLLHUP | poll_rdhup)
+        reported: set = set()
+        while True:
+            with self._peers_lock:
+                if gen != self._generation:
+                    return
+            try:
+                events = poller.poll(200)
+            except OSError:
+                return
+            for fd, ev in events:
+                if ev & select.POLLNVAL:
+                    try:
+                        poller.unregister(fd)
+                    except (KeyError, OSError):
+                        pass
+                    continue
+                rank = fds.get(fd)
+                if rank is None or rank in reported:
+                    continue
+                reported.add(rank)
+                try:
+                    poller.unregister(fd)
+                except (KeyError, OSError):
+                    pass
+                with self._peers_lock:
+                    if gen != self._generation:
+                        return
+                cb = self._death_watch_cb
+                if cb is not None:
+                    try:
+                        cb(rank)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("death-watch callback failed")
 
     def _configure_dp(self, rank: int, world_size: int) -> None:
         """Stand up the striped C++ gradient plane for this epoch. Same

@@ -182,6 +182,11 @@ class Manager:
             max_workers=1, thread_name_prefix="async_quorum"
         )
         self._quorum_future: Optional[concurrent.futures.Future] = None
+        # guards _quorum_future replacement: the death watch may submit a
+        # premature re-quorum from its monitor thread (see _on_peer_death)
+        self._qf_lock = threading.Lock()
+        self._shutting_down = False
+        self._last_quorum_args: tuple = (True, False, None)
 
         self._store = StoreClient(store_addr, connect_timeout=connect_timeout)
         self._collectives = collectives
@@ -217,6 +222,16 @@ class Manager:
         self._evicted: set = set()  # victims already reported this epoch
         self._commit_failures = 0  # pending data-plane flush request
         self._errored: Optional[Exception] = None
+        self._errored_epoch = -1  # quorum_id whose plane produced _errored
+        self._step_epochs: set = set()  # quorum_ids this step's ops ran on
+
+        # Active failure detection: the data plane's sockets learn about a
+        # dead peer (FIN/RST) within milliseconds — long before the next
+        # collective op would trip over them. Wire that signal back so the
+        # eviction + re-quorum overlap the doomed step instead of starting
+        # at the next step boundary (the "<1 step" recovery envelope).
+        if hasattr(collectives, "set_death_watch"):
+            collectives.set_death_watch(self._on_peer_death)
         self._healing = False
         self._group_healing = False
         self._pending_work: List[Future] = []
@@ -233,6 +248,7 @@ class Manager:
 
     def shutdown(self, wait: bool = True) -> None:
         """Shut down the manager, checkpoint transport and data plane."""
+        self._shutting_down = True
         self._checkpoint_transport.shutdown(wait=wait)
         if self._manager is not None:
             self._manager.shutdown()
@@ -257,27 +273,33 @@ class Manager:
         All replicas must pass the same ``allow_heal``. With
         ``shrink_only`` the quorum can only lose members (planned
         downscale)."""
-        # wait for a previous quorum to finish before mutating state
-        if self._quorum_future is not None:
-            try:
-                self._quorum_future.result()
-            except Exception as e:  # noqa: BLE001
-                # the failure already surfaced to the caller through
-                # wait_quorum/allreduce/should_commit on the step that
-                # scheduled it; calling start_quorum again IS the retry —
-                # start fresh instead of re-raising history forever
-                self._logger.warn(f"previous quorum attempt failed ({e}); retrying")
-
         self._errored = None
         self._healing = False
         self._group_healing = False
+        self._step_epochs = set()
 
-        self._quorum_future = self._executor.submit(
-            self._async_quorum,
-            allow_heal=allow_heal,
-            shrink_only=shrink_only,
-            quorum_timeout=timeout or self._quorum_timeout,
-        )
+        # hold the lock across wait+replace: a death-watch submission
+        # sliding in between would be silently overwritten (its exception
+        # never observed, a duplicate lighthouse RPC from this replica)
+        with self._qf_lock:
+            if self._quorum_future is not None:
+                try:
+                    self._quorum_future.result()
+                except Exception as e:  # noqa: BLE001
+                    # the failure already surfaced to the caller through
+                    # wait_quorum/allreduce/should_commit on the step that
+                    # scheduled it; calling start_quorum again IS the retry —
+                    # start fresh instead of re-raising history forever
+                    self._logger.warn(
+                        f"previous quorum attempt failed ({e}); retrying"
+                    )
+            self._last_quorum_args = (allow_heal, shrink_only, timeout)
+            self._quorum_future = self._executor.submit(
+                self._async_quorum,
+                allow_heal=allow_heal,
+                shrink_only=shrink_only,
+                quorum_timeout=timeout or self._quorum_timeout,
+            )
         if not self._use_async_quorum:
             self.wait_quorum()
             if self._healing:
@@ -469,6 +491,20 @@ class Manager:
             return Future.completed(tensors)
 
         self.wait_quorum()
+        # record which plane epoch this op rides: a death-watch re-quorum
+        # can land MID-step, and a step whose ops span two epochs mixes
+        # normalization denominators — should_commit vetoes those
+        self._step_epochs.add(self._quorum_id)
+        # participant count captured at ISSUE time: an op can never span
+        # plane epochs (configure tears down its executor, cancelling or
+        # failing it), so the membership the op actually reduced over is
+        # the one in force now. Reading it at COMPLETION time instead
+        # would (a) mis-scale a finished op if a death-watch re-quorum
+        # lands before its callback runs, and (b) deadlock: the callback
+        # runs on the collectives op thread, and blocking there on the
+        # re-quorum future while its configure() waits to join that very
+        # thread is a cycle.
+        n_at_issue = self._participating_world_size
 
         # branch on the *configured* data plane, not the input type: the
         # device backend converts numpy inputs to jax.Arrays, so its results
@@ -497,7 +533,7 @@ class Manager:
                 except BaseException as e:  # noqa: BLE001 — annotate + rethrow
                     e._tft_participants = ids_snapshot
                     raise
-                n = self.num_participants()
+                n = n_at_issue
                 if n <= 1:
                     return reduced  # dividing by 1 would only cost a kernel
                 if device:
@@ -506,7 +542,12 @@ class Manager:
                     np.divide(t, n, out=t)
                 return reduced
 
-            return self.wrap_future(work.get_future().then(normalize), tensors)
+            fut = self.wrap_future(work.get_future().then(normalize), tensors)
+            # close the issue-time race: if a death-watch reconfigure slid
+            # in between the epoch read above and the submission, the two
+            # reads differ and the veto catches the step
+            self._step_epochs.add(self._quorum_id)
+            return fut
         except Exception as e:  # noqa: BLE001 — latch and continue
             self._logger.exception(f"exception in allreduce, skipping remaining: {e}")
             self.report_error(e)
@@ -519,7 +560,60 @@ class Manager:
         replica is reported to the lighthouse for immediate eviction so
         the re-quorum doesn't wait out the heartbeat lease."""
         self._errored = e
+        self._errored_epoch = self._quorum_id
         self._maybe_evict(e)
+
+    def _on_peer_death(self, ring_rank: int) -> None:
+        """Death-watch callback (runs on the collectives monitor thread):
+        a peer's socket hit EOF/error mid-epoch. Report the eviction NOW
+        (liveness-probe-guarded at the lighthouse, so a false positive is
+        harmless) and, if no quorum RPC is in flight, start one — by the
+        time the trainer finishes the doomed step, the shrunken quorum is
+        usually already delivered and the plane reconfigured, so the
+        survivor pays ~one step instead of detection+quorum+reconfigure
+        serialized after it."""
+        from torchft_tpu.collectives import PeerGoneError
+
+        if self._shutting_down:
+            return
+        self._maybe_evict(
+            PeerGoneError(ring_rank, f"death watch: peer {ring_rank} socket closed")
+        )
+        with self._qf_lock:
+            if self._shutting_down:
+                return
+            fut = self._quorum_future
+            if fut is None or not fut.done():
+                # a quorum RPC is already in flight; it observes the
+                # eviction when the lighthouse re-forms the quorum
+                return
+            # Only pre-quorum when the SURVIVING membership can form a
+            # quorum without waiting for a restart: otherwise the early
+            # long-poll parks the trainer's wait_quorum on a quorum that
+            # cannot form until the victim respawns — strictly worse than
+            # the old fail-fast-then-retry path.
+            alive = len(
+                [p for p in self._participant_ids if p not in self._evicted]
+            )
+            if alive < max(1, self._min_replica_size):
+                return
+            _, shrink_only, timeout = self._last_quorum_args
+            self._logger.info(
+                f"death watch: peer {ring_rank} gone; starting early re-quorum"
+            )
+            # allow_heal=False: this quorum exists ONLY to shrink
+            # membership and rebuild the plane under the doomed step.
+            # Serving a heal here would read user state on a thread the
+            # trainer doesn't synchronize with (it may be mid-optimizer-
+            # update after a commit) — rejoiners heal one step later on
+            # the regular start_quorum cadence, where checkpoint staging
+            # is trainer-synchronized.
+            self._quorum_future = self._executor.submit(
+                self._async_quorum,
+                allow_heal=False,
+                shrink_only=shrink_only,
+                quorum_timeout=timeout or self._quorum_timeout,
+            )
 
     def _maybe_evict(self, e: BaseException) -> None:
         """Fire-and-forget lh.evict for a PeerGoneError's peer. Runs on a
@@ -617,7 +711,13 @@ class Manager:
             self._apply_pending_state_dict()
 
         enough_replicas = self.num_participants() >= self._min_replica_size
-        local_should_commit = enough_replicas and self._errored is None
+        # a step whose collectives spanned two plane epochs (death-watch
+        # re-quorum mid-step) mixed normalization denominators — every
+        # rank sees the same span, so the veto is group-consistent
+        mixed_epochs = len(self._step_epochs) > 1
+        local_should_commit = (
+            enough_replicas and self._errored is None and not mixed_epochs
+        )
         should_commit = self._client.should_commit(
             self._rank,
             self._step,
@@ -633,9 +733,11 @@ class Manager:
         # state is stale
         self._checkpoint_transport.disallow_checkpoint()
 
-        if self._errored is not None:
+        if self._errored is not None and self._errored_epoch == self._quorum_id:
             # the data plane is suspect: request a flush so the next quorum
-            # reconfigures every group into a fresh rendezvous epoch
+            # reconfigures every group into a fresh rendezvous epoch. An
+            # error from a PREVIOUS epoch's plane needs no flush — the
+            # death-watch re-quorum already rebuilt connectivity
             self._commit_failures += 1
 
         if should_commit:
