@@ -321,11 +321,15 @@ def main() -> None:
         },
     }
 
-    # long-context variants (TPU only): XLA fused attention — the auto
-    # rule engages the pallas flash kernel only past the scores-memory
-    # ceiling (models/transformer._use_flash), where plain attention
-    # cannot fit at all
+    # long-context variants (TPU only): the auto rule routes s>=4096 to
+    # tiered chunked-scan attention (ops/attention.chunked_attention) —
+    # per-block fused scores, static causal k-prefix tiers; round-4 took
+    # s=8192 from 15.0% to ~31% MFU and made s=32k L=8 single-chip viable
     if on_tpu:
+        attn_note = (
+            "tiered chunked-scan attention (pure XLA; see "
+            "ops/attention.chunked_attention + transformer._use_chunked)"
+        )
         lc_batch, lc_seq = 2, 4096
         lc_sps, _ = train_bench(cfg, lc_batch, lc_seq, 10, 2, averaging=True)
         lc_flops = _model_flops_per_step(cfg, n_params, lc_batch, lc_seq)
@@ -333,19 +337,25 @@ def main() -> None:
             "steps_per_sec": round(lc_sps, 4),
             "tokens_per_sec": round(lc_sps * lc_batch * lc_seq),
             "mfu_pct": round(lc_sps * lc_flops / peak * 100.0, 2) if peak else None,
-            "attention": "xla fused (pallas flash engages only past the "
-            "scores-memory ceiling; see models/transformer._use_flash)",
+            "attention": attn_note,
         }
-        # s=8192: the round-3 auto-rule fix (flash only past the memory
-        # ceiling) took this config 449 -> ~39k tok/s
         xl_sps, _ = train_bench(cfg, 1, 8192, 6, 2, averaging=True)
         xl_flops = _model_flops_per_step(cfg, n_params, 1, 8192)
         extra["long_context_s8192"] = {
             "steps_per_sec": round(xl_sps, 4),
             "tokens_per_sec": round(xl_sps * 8192),
             "mfu_pct": round(xl_sps * xl_flops / peak * 100.0, 2) if peak else None,
-            "attention": "xla fused; 32k+ sequences route to the pallas "
-            "flash kernel (memory-ceiling path)",
+            "attention": attn_note,
+        }
+        xxl_sps, _ = train_bench(cfg, 1, 16384, 4, 2, averaging=True)
+        xxl_flops = _model_flops_per_step(cfg, n_params, 1, 16384)
+        extra["long_context_s16384"] = {
+            "steps_per_sec": round(xxl_sps, 4),
+            "tokens_per_sec": round(xxl_sps * 16384),
+            "mfu_pct": round(xxl_sps * xxl_flops / peak * 100.0, 2)
+            if peak
+            else None,
+            "attention": attn_note,
         }
 
     # scale variant (TPU only): the d512 headline model is small enough to

@@ -150,3 +150,96 @@ def test_chunked_loss_matches_dense(monkeypatch):
         jax.tree_util.tree_leaves(g_dense), jax.tree_util.tree_leaves(g_chunk)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+class TestChunkedAttention:
+    """Round-4 tiered chunked-scan attention: the pure-XLA long-context
+    path (s=8192: 15% -> ~31% MFU on v5e). Must be numerically the same
+    attention as the plain reference, including across tier boundaries
+    and under grad."""
+
+    def _qkv(self, s, b=2, h=4, d=32, seed=0):
+        import jax
+
+        key = jax.random.PRNGKey(seed)
+        ks = jax.random.split(key, 3)
+        shp = (b, s, h, d)
+        return tuple(jax.random.normal(k, shp, jnp.float32) for k in ks)
+
+    @pytest.mark.parametrize("s,chunk,tiers", [(512, 128, 4), (256, 64, 1), (384, 64, 3)])
+    def test_matches_plain(self, s, chunk, tiers):
+        from torchft_tpu.ops.attention import attention, chunked_attention
+
+        q, k, v = self._qkv(s)
+        ref = attention(q, k, v, causal=True)
+        got = chunked_attention(q, k, v, causal=True, chunk=chunk, tiers=tiers)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    def test_non_causal_matches(self):
+        from torchft_tpu.ops.attention import attention, chunked_attention
+
+        q, k, v = self._qkv(256)
+        ref = attention(q, k, v, causal=False)
+        got = chunked_attention(q, k, v, causal=False, chunk=64)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    def test_grad_matches_plain(self):
+        import jax
+
+        from torchft_tpu.ops.attention import attention, chunked_attention
+
+        q, k, v = self._qkv(256)
+
+        def loss(fn):
+            return lambda q, k, v: (fn(q, k, v, causal=True) ** 2).sum()
+
+        gref = jax.grad(loss(attention), argnums=(0, 1, 2))(q, k, v)
+        gchk = jax.grad(
+            lambda q, k, v: (
+                chunked_attention(q, k, v, causal=True, chunk=64, tiers=4) ** 2
+            ).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(gref, gchk):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+    def test_model_routes_chunked(self, monkeypatch):
+        """attention_impl='chunked' trains; auto engages past the S
+        threshold (lowered via env for a CPU-sized check)."""
+        import jax
+        import optax
+
+        from torchft_tpu.models.transformer import (
+            TransformerConfig,
+            _use_chunked,
+        )
+        from torchft_tpu.parallel.train_step import TrainStep
+        from torchft_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        cfg = TransformerConfig(
+            vocab_size=128,
+            d_model=64,
+            n_layers=2,
+            n_heads=4,
+            head_dim=16,
+            d_ff=128,
+            dtype=jnp.float32,
+            attention_impl="chunked",
+        )
+        assert _use_chunked(cfg, 512)
+        monkeypatch.setenv("TORCHFT_TPU_ATTN_CHUNKED_MIN_S", "512")
+        auto = TransformerConfig(**{**cfg.__dict__, "attention_impl": "auto"})
+        assert _use_chunked(auto, 512)
+        assert not _use_chunked(auto, 256)
+
+        mesh = make_mesh(MeshConfig())
+        ts = TrainStep(cfg, optax.adam(1e-2), mesh)
+        params = ts.init_params(jax.random.PRNGKey(0))
+        opt = ts.init_opt(params)
+        tokens = ts.shard_batch(
+            jnp.asarray(
+                np.random.default_rng(0).integers(0, 128, (2, 512)), jnp.int32
+            )
+        )
+        loss, _, _ = ts.step(params, opt, tokens)
+        assert np.isfinite(float(loss))
