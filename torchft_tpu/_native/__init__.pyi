@@ -1,0 +1,81 @@
+# Typed surface of the ctypes binding layer — the torchft/_torchft.pyi
+# analogue (reference ships stubs for its Rust binary module; the ctypes
+# internals here otherwise type as Any). coordination.py's wrapper classes
+# (LighthouseServer/ManagerServer/ManagerClient/QuorumResult) are plain
+# Python with inline annotations, consumed via the package's py.typed
+# marker; this stub covers the layer beneath them.
+
+from typing import Any, Dict, List, Tuple
+
+OK: int
+CANCELLED: int
+INVALID_ARGUMENT: int
+NOT_FOUND: int
+DEADLINE_EXCEEDED: int
+INTERNAL: int
+UNAVAILABLE: int
+
+class NativeClient:
+    def __init__(self, addr: str, connect_timeout_ms: int) -> None: ...
+    @property
+    def addr(self) -> str: ...
+    def call(
+        self, method: str, req: Dict[str, Any], timeout_ms: int
+    ) -> Dict[str, Any]: ...
+    def close(self) -> None: ...
+
+def lighthouse_create(
+    bind: str,
+    min_replicas: int,
+    join_timeout_ms: int,
+    quorum_tick_ms: int,
+    heartbeat_timeout_ms: int,
+    evict_probe_ms: int = ...,
+) -> Tuple[int, str]: ...
+def lighthouse_shutdown(h: int) -> None: ...
+def manager_create(
+    replica_id: str,
+    lighthouse_addr: str,
+    hostname: str,
+    bind: str,
+    store_addr: str,
+    world_size: int,
+    heartbeat_interval_ms: int,
+    connect_timeout_ms: int,
+) -> Tuple[int, str]: ...
+def manager_shutdown(h: int) -> None: ...
+def store_create(bind: str) -> Tuple[int, str]: ...
+def store_shutdown(h: int) -> None: ...
+def quorum_compute(state: Dict[str, Any]) -> Dict[str, Any]: ...
+def compute_quorum_results(
+    quorum: Dict[str, Any], replica_id: str, rank: int
+) -> Dict[str, Any]: ...
+def cma_read(pid: int, addr: int, n: int) -> bytes: ...
+
+class DataPlaneError(ConnectionError):
+    peer_rank: int
+    def __init__(self, peer_rank: int, msg: str) -> None: ...
+
+class NativeDataPlane:
+    DTYPE_F32: int
+    OP: Dict[str, int]
+    rank: int
+    world: int
+    nstripes: int
+    port: int
+    def __init__(self, rank: int, world: int, nstripes: int = ...) -> None: ...
+    def connect(
+        self, peer: int, host: str, port: int, timeout_ms: int
+    ) -> None: ...
+    def wait_ready(self, timeout_ms: int) -> None: ...
+    def enable_cma(self, pids: List[int]) -> None: ...
+    def allreduce(
+        self,
+        ptr: int,
+        nelems: int,
+        op: str,
+        wire_bf16: bool,
+        tag: int,
+        timeout_ms: int,
+    ) -> None: ...
+    def close(self) -> None: ...
