@@ -65,7 +65,7 @@ def _model_flops_per_step(cfg, n_params: int, batch: int, seq: int) -> float:
 
 
 @contextmanager
-def _single_group_ft_runtime(replica_id: str):
+def _single_group_ft_runtime(replica_id: str, use_async_quorum: bool = True):
     """Full FT control plane for a 1-group bench: C++ lighthouse + store +
     Manager over the device-path data plane (on a multi-group slice the
     same code averages over the 'ft' mesh axis via ICI, no host staging).
@@ -95,6 +95,7 @@ def _single_group_ft_runtime(replica_id: str):
         rank=0,
         world_size=1,
         lighthouse_addr=lighthouse.address(),
+        use_async_quorum=use_async_quorum,
     )
     try:
         yield manager
@@ -104,7 +105,8 @@ def _single_group_ft_runtime(replica_id: str):
         lighthouse.shutdown()
 
 
-def train_bench(cfg, batch, seq, steps, warmup, averaging: bool):
+def train_bench(cfg, batch, seq, steps, warmup, averaging: bool,
+                use_async_quorum: bool = True):
     """Measured FT train loop; returns steps/s."""
     import jax
     import jax.numpy as jnp
@@ -114,7 +116,7 @@ def train_bench(cfg, batch, seq, steps, warmup, averaging: bool):
     from torchft_tpu.parallel.mesh import MeshConfig, make_mesh
     from torchft_tpu.parallel.train_step import TrainStep
 
-    with _single_group_ft_runtime("bench") as manager:
+    with _single_group_ft_runtime("bench", use_async_quorum) as manager:
         mesh = make_mesh(MeshConfig(dp=1))  # single chip; FT axis is cross-group
         ts = TrainStep(cfg, optax.adamw(3e-4), mesh)
         params = ts.init_params(jax.random.PRNGKey(0))
@@ -387,6 +389,47 @@ def main() -> None:
             extra["resnet18_cifar"] = _resnet_bench(steps=20, warmup=3, batch=256)
         except Exception as e:  # noqa: BLE001
             extra["resnet18_cifar"] = {"error": str(e)}
+
+    # sync-vs-async quorum at the headline config: the async default
+    # (manager.py) overlaps the quorum RPC with the forward pass — this
+    # artifact is the evidence behind that default (round-3 review weak)
+    if on_tpu:
+        try:
+            # interleaved median-of-3 per variant: a single pair of runs
+            # would let host contamination on one leg fabricate the gain
+            qo_async_runs, qo_sync_runs = [], []
+            for _ in range(3):
+                r, _ = train_bench(cfg, batch, seq, 10, 2, averaging=True)
+                qo_async_runs.append(r)
+                r, _ = train_bench(
+                    cfg, batch, seq, 10, 2, averaging=True,
+                    use_async_quorum=False,
+                )
+                qo_sync_runs.append(r)
+            qo_async = sorted(qo_async_runs)[1]
+            qo_sync = sorted(qo_sync_runs)[1]
+            extra["quorum_overlap"] = {
+                "async_steps_per_sec": round(qo_async, 4),
+                "sync_steps_per_sec": round(qo_sync, 4),
+                "async_gain_pct": round((qo_async / qo_sync - 1) * 100.0, 2),
+                "async_runs": [round(r, 4) for r in qo_async_runs],
+                "sync_runs": [round(r, 4) for r in qo_sync_runs],
+                "config": "headline model/shape, 10 steps, single group, "
+                "interleaved median-of-3",
+            }
+        except Exception as e:  # noqa: BLE001
+            extra["quorum_overlap"] = {"error": str(e)}
+
+    # DiLoCo 4-group effective cost (BASELINE.md target config): per-sync
+    # seconds + amortized overhead over the host plane
+    try:
+        extra["diloco_4group"] = _run_json_subprocess(
+            [sys.executable, "-m", "torchft_tpu.benchmarks.diloco"],
+            timeout_s=600,
+            env_extra={"JAX_PLATFORMS": "cpu"},
+        )
+    except Exception as e:  # noqa: BLE001
+        extra["diloco_4group"] = {"error": str(e)}
 
     # REAL 2-group device-path averaging on a virtual 8-CPU mesh (round-2
     # review weak #1: the single-chip headline can't measure it)

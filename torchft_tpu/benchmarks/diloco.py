@@ -1,0 +1,263 @@
+"""DiLoCo 4-group cost benchmark (BASELINE.md "DiLoCo 4 groups" config).
+
+Round-3 review missing: DiLoCo was correctness-tested but no artifact
+reported its *effective* overhead — what the once-per-H-steps pseudo-
+gradient averaging over the host plane actually costs. This harness runs
+``examples/train_diloco.py``'s exact training configuration (d32→h64→10
+MLP, AdamW inner, Nesterov-SGD outer, sync_every=8) as 4 replica-group
+subprocesses over CollectivesTcp and separates wall-clock into the inner
+loop vs the sync (quorum + averaging + outer step), reporting per-sync
+seconds and the amortized overhead percentage.
+
+Usage::
+
+    python -m torchft_tpu.benchmarks.diloco [--outer-steps 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List
+
+
+# examples/train_diloco.py's exact model/data/loss, inlined: the examples
+# directory does not ship in wheels, so the bench cannot import it
+def _make_dataset(n=4096, d=32, classes=10, seed=7):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = rng.standard_normal((d, classes)).astype(np.float32)
+    y = np.argmax(x @ w_true + 0.1 * rng.standard_normal((n, classes)), axis=1)
+    return x, y.astype(np.int32)
+
+
+def _init_params(d=32, hidden=64, classes=10, seed=42):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    scale = 1.0 / np.sqrt(d)
+    return {
+        "w1": (scale * rng.standard_normal((d, hidden))).astype(np.float32),
+        "b1": np.zeros(hidden, np.float32),
+        "w2": (scale * rng.standard_normal((hidden, classes))).astype(np.float32),
+        "b2": np.zeros(classes, np.float32),
+    }
+
+
+def _loss_fn(params, x, y):
+    import jax
+    import optax
+
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def _worker_main(argv: List[str]) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gid", type=int, required=True)
+    parser.add_argument("--num-groups", type=int, default=4)
+    parser.add_argument("--outer-steps", type=int, default=6)
+    parser.add_argument("--sync-every", type=int, default=8)
+    args = parser.parse_args(argv)
+
+    from datetime import timedelta
+
+    import numpy as np
+
+    from torchft_tpu.utils.platform import pin_platform_from_env
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    pin_platform_from_env()
+
+    import jax
+    import optax
+
+    from torchft_tpu.collectives import CollectivesTcp
+    from torchft_tpu.local_sgd import DiLoCo
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.store import StoreServer
+
+    store = StoreServer()
+    manager = Manager(
+        collectives=CollectivesTcp(timeout=timedelta(seconds=30)),
+        load_state_dict=None,
+        state_dict=None,
+        min_replica_size=min(2, args.num_groups),
+        use_async_quorum=False,  # the example's setting (heal before sync)
+        replica_id=f"dilocobench_{args.gid}",
+        store_addr=store.address(),
+        rank=0,
+        world_size=1,
+        timeout=timedelta(seconds=30),
+        quorum_timeout=timedelta(seconds=120),
+    )
+    try:
+        x, y = _make_dataset()
+        inner_tx = optax.adamw(1e-3)
+        outer_tx = optax.sgd(0.7, momentum=0.9, nesterov=True)
+        params = _init_params()
+        inner = inner_tx.init(params)
+        diloco = DiLoCo(manager, outer_tx, sync_every=args.sync_every)
+        diloco.save(params)
+        manager.set_state_dict_fns(lambda s: None, lambda: {})
+
+        @jax.jit
+        def inner_step(params, opt_state, xb, yb):
+            loss, grads = jax.value_and_grad(_loss_fn)(params, xb, yb)
+            updates, opt_state = inner_tx.update(grads, opt_state, params)
+            return loss, optax.apply_updates(params, updates), opt_state
+
+        rng = np.random.default_rng(args.gid)
+        batch = 64
+        inner_s = 0.0
+        inner_steps = 0
+        sync_times: List[float] = []
+        warm_syncs = 1  # first sync pays quorum formation; exclude it
+
+        while manager.current_step() < args.outer_steps + warm_syncs:
+            idx = rng.integers(0, len(x), batch)
+            t0 = time.perf_counter()
+            loss, params, inner = inner_step(params, inner, x[idx], y[idx])
+            float(loss)  # fence
+            inner_s += time.perf_counter() - t0
+            inner_steps += 1
+            t0 = time.perf_counter()
+            synced = diloco.step(params)
+            dt = time.perf_counter() - t0
+            if synced is not params:
+                params = synced
+                inner = inner_tx.init(synced)
+                if manager.current_step() > warm_syncs:
+                    sync_times.append(dt)
+            else:
+                inner_s += dt
+        n_bytes = sum(
+            int(np.prod(v.shape)) * 4 for v in jax.tree_util.tree_leaves(params)
+        )
+        print(
+            json.dumps(
+                {
+                    "gid": args.gid,
+                    "inner_s": inner_s,
+                    "inner_steps": inner_steps,
+                    "sync_times": sync_times,
+                    "payload_bytes": n_bytes,
+                }
+            ),
+            flush=True,
+        )
+    finally:
+        manager.shutdown(wait=False)
+        store.shutdown()
+
+
+def measure_diloco(
+    num_groups: int = 4, outer_steps: int = 6, sync_every: int = 8
+) -> Dict[str, object]:
+    from torchft_tpu.coordination import LighthouseServer
+
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=num_groups)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["TORCHFT_LIGHTHOUSE"] = lighthouse.address().split("//", 1)[-1]
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_parent, env.get("PYTHONPATH")) if p
+    )
+    procs = []
+    try:
+        for gid in range(num_groups):
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        "-m",
+                        "torchft_tpu.benchmarks.diloco",
+                        "--worker",
+                        "--gid",
+                        str(gid),
+                        "--num-groups",
+                        str(num_groups),
+                        "--outer-steps",
+                        str(outer_steps),
+                        "--sync-every",
+                        str(sync_every),
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    env=env,
+                )
+            )
+        # drain all pipes CONCURRENTLY: the workers are barrier-coupled,
+        # so sequentially draining one while another blocks on a full
+        # stderr pipe would stall the whole cohort. Inner timeout stays
+        # below bench.py's outer 600s cap so worker stderr survives.
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(procs)) as pool:
+            futs = [pool.submit(p.communicate, 500) for p in procs]
+            outs = [f.result() for f in futs]
+        results = []
+        for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"diloco worker rc={p.returncode}: {err.decode()[-2000:]}"
+                )
+            results.append(json.loads(out.decode().strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        lighthouse.shutdown()
+
+    # per outer round, the slowest group's sync gates everyone
+    per_sync = [max(r["sync_times"][i] for r in results)
+                for i in range(min(len(r["sync_times"]) for r in results))]
+    sync_s = sum(per_sync)
+    inner_s = max(r["inner_s"] for r in results)
+    inner_steps = results[0]["inner_steps"]
+    total = inner_s + sync_s
+    return {
+        "num_groups": num_groups,
+        "sync_every": sync_every,
+        "outer_steps_measured": len(per_sync),
+        "inner_steps_per_sec": round(inner_steps / inner_s, 2) if inner_s else None,
+        "per_sync_seconds": round(sync_s / max(1, len(per_sync)), 4),
+        "overhead_pct": round(100.0 * sync_s / total, 2) if total else None,
+        "payload_bytes": results[0]["payload_bytes"],
+        "config": "examples/train_diloco.py MLP (d32 h64 c10), adamw inner, "
+        "nesterov-sgd outer, host TCP plane, sync quorum; first sync "
+        "(quorum formation) excluded",
+    }
+
+
+def main() -> None:
+    if "--worker" in sys.argv:
+        _worker_main([a for a in sys.argv[1:] if a != "--worker"])
+        return
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--num-groups", type=int, default=4)
+    parser.add_argument("--outer-steps", type=int, default=6)
+    parser.add_argument("--sync-every", type=int, default=8)
+    args = parser.parse_args()
+    print(
+        json.dumps(
+            measure_diloco(args.num_groups, args.outer_steps, args.sync_every)
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
