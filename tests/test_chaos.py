@@ -13,10 +13,17 @@ import signal
 import subprocess
 import sys
 import time
+import pytest
 
 import numpy as np
 
 from torchft_tpu.coordination import LighthouseServer
+
+# multi-process soak tier: excluded from the default run (pyproject
+# addopts); execute with `pytest -m soak`
+from conftest import scaled_timeout
+
+pytestmark = pytest.mark.soak
 
 _EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
 
@@ -82,7 +89,7 @@ def test_repeated_kill_restart_converges(tmp_path):
 
         outs = {}
         for g in (0, 1):
-            out, _ = procs[g].communicate(timeout=300)
+            out, _ = procs[g].communicate(timeout=scaled_timeout(300))
             assert procs[g].returncode == 0, out.decode()[-2000:]
             outs[g] = out.decode()
     finally:

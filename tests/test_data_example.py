@@ -19,6 +19,12 @@ import pytest
 
 from torchft_tpu.coordination import LighthouseServer
 
+# multi-process soak tier: excluded from the default run (pyproject
+# addopts); execute with `pytest -m soak`
+from conftest import scaled_timeout
+
+pytestmark = pytest.mark.soak
+
 _EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
 
 STEPS = 24
@@ -84,7 +90,7 @@ def test_kill_restart_no_sample_skipped_or_repeated(tmp_path):
         # restart: disk-resume + live heal, then run to completion
         procs[1] = _spawn(1, addr, tmp)
         for g in (0, 1):
-            out, _ = procs[g].communicate(timeout=300)
+            out, _ = procs[g].communicate(timeout=scaled_timeout(300))
             assert procs[g].returncode == 0, out.decode()[-2000:]
     finally:
         for p in procs.values():

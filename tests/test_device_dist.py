@@ -1,0 +1,108 @@
+"""CollectivesDeviceDist: 2 replica groups as separate OS PROCESSES
+averaging over ONE shared multi-controller JAX runtime — the round-3
+review's missing topology (the in-process CollectivesDevice registry
+can't span processes; the launcher/k8s put every group in its own).
+On real hardware the psum rides ICI; here the runtime is 2 CPU
+processes × 2 virtual devices."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import scaled_timeout
+
+# multi-process soak tier: excluded from the default run (pyproject addopts)
+pytestmark = pytest.mark.soak
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import os, sys, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, "__REPO__")
+import numpy as np
+from torchft_tpu.collectives import ReduceOp
+from torchft_tpu.collectives_device_dist import CollectivesDeviceDist, init_distributed
+
+gid = int(sys.argv[1]); coordinator = sys.argv[2]; out = sys.argv[3]
+init_distributed(coordinator, 2, gid)
+assert jax.process_count() == 2
+
+c = CollectivesDeviceDist()
+c.configure("", gid, 2)
+
+rng = np.random.default_rng(5 + gid)
+a = rng.standard_normal(10001).astype(np.float32)
+orig = a.copy()
+c.allreduce([a], ReduceOp.AVG).wait()
+
+ag = c.allgather(np.full(4, float(gid), np.float32)).wait()
+b = np.zeros(3, np.float32) if gid else np.arange(3, dtype=np.float32)
+c.broadcast(b, root=0).wait()
+c.barrier().wait()
+
+# cohort mismatch must raise loudly, not deadlock
+try:
+    c.configure("", gid, 3)
+    mismatch = "no-error"
+except RuntimeError as e:
+    mismatch = "raised"
+
+with open(out, "w") as f:
+    json.dump({
+        "sum": float(a.sum()), "first": float(a[0]),
+        "own_mean_first": float(orig[0]),
+        "ag": [float(x[0]) for x in ag],
+        "bcast": [float(x) for x in b],
+        "mismatch": mismatch,
+    }, f)
+"""
+
+
+def test_two_process_shared_runtime_allreduce(tmp_path):
+    from torchft_tpu.launcher import _free_port
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER.replace("__REPO__", REPO))
+    coordinator = f"localhost:{_free_port()}"
+    outs = [str(tmp_path / f"g{g}.json") for g in range(2)]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(g), coordinator, outs[g]],
+            env=env,
+            cwd=REPO,
+        )
+        for g in range(2)
+    ]
+    try:
+        for p in procs:
+            assert p.wait(timeout=scaled_timeout(120)) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    import json
+
+    import numpy as np
+
+    r0, r1 = (json.load(open(o)) for o in outs)
+    # both processes hold the bitwise-identical average
+    assert r0["sum"] == r1["sum"]
+    assert r0["first"] == r1["first"]
+    # and it IS an average of the two inputs, not either one alone
+    rng0 = np.random.default_rng(5).standard_normal(10001).astype(np.float32)
+    rng1 = np.random.default_rng(6).standard_normal(10001).astype(np.float32)
+    np.testing.assert_allclose(
+        r0["first"], (rng0[0] + rng1[0]) / 2.0, rtol=1e-6
+    )
+    assert r0["ag"] == [0.0, 1.0] and r1["ag"] == [0.0, 1.0]
+    assert r0["bcast"] == [0.0, 1.0, 2.0] and r1["bcast"] == [0.0, 1.0, 2.0]
+    assert r0["mismatch"] == "raised" and r1["mismatch"] == "raised"

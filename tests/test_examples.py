@@ -7,10 +7,17 @@ import os
 import re
 import subprocess
 import sys
+import pytest
 from concurrent.futures import ThreadPoolExecutor
 
 from torchft_tpu.coordination import LighthouseServer
 from torchft_tpu.store import StoreServer
+
+# multi-process soak tier: excluded from the default run (pyproject
+# addopts); execute with `pytest -m soak`
+from conftest import scaled_timeout
+
+pytestmark = pytest.mark.soak
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -39,7 +46,7 @@ def _run_groups(script: str, num_groups: int, extra_env: dict, min_replicas=None
                 env=env,
                 capture_output=True,
                 text=True,
-                timeout=240,
+                timeout=scaled_timeout(240),
                 cwd=REPO,
             )
             assert proc.returncode == 0, proc.stderr[-3000:]

@@ -27,6 +27,9 @@ from torchft_tpu.parallel.ft import FTTrainer
 from torchft_tpu.parallel.mesh import MeshConfig, make_mesh
 from torchft_tpu.parallel.train_step import TrainStep
 
+# compile-heavy slow tier: excluded from the default run (pyproject addopts)
+pytestmark = pytest.mark.slow
+
 CFG = TransformerConfig(
     vocab_size=64,
     d_model=16,

@@ -11,9 +11,17 @@ import re
 import subprocess
 import sys
 
+import pytest
+
 from torchft_tpu.coordination import LighthouseServer
 from torchft_tpu.launcher import _free_port
 from torchft_tpu.store import StoreServer
+
+# multi-process soak tier: excluded from the default run (pyproject
+# addopts); execute with `pytest -m soak`
+from conftest import scaled_timeout
+
+pytestmark = pytest.mark.soak
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -87,7 +95,7 @@ def test_multihost_group_kill_respawn_heal(tmp_path):
             pytest.skip("run finished before the kill could land mid-flight")
         pid = int(victim.name.split(".")[1])
         os.kill(pid, signal.SIGKILL)
-        assert launcher.wait(timeout=240) == 0
+        assert launcher.wait(timeout=scaled_timeout(240)) == 0
     finally:
         if launcher.poll() is None:
             launcher.send_signal(signal.SIGINT)
@@ -140,7 +148,7 @@ def test_two_groups_of_two_processes(tmp_path):
                     )
                 )
         for p in procs:
-            assert p.wait(timeout=180) == 0
+            assert p.wait(timeout=scaled_timeout(180)) == 0
         results = []
         for out in outs:
             with open(out) as f:

@@ -9,7 +9,13 @@ cadence (1s op timeout, 1s heartbeat lease) so the test is about the
 luck.
 """
 
+import pytest
+
 from torchft_tpu.benchmarks.recovery import measure_recovery
+
+# multi-process soak tier: excluded from the default run (pyproject
+# addopts); execute with `pytest -m soak`
+pytestmark = pytest.mark.soak
 
 
 def test_recovery_envelope():

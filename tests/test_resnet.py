@@ -8,6 +8,9 @@ import pytest
 
 from torchft_tpu.models import resnet
 
+# compile-heavy slow tier: excluded from the default run (pyproject addopts)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def model():

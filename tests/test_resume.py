@@ -17,6 +17,9 @@ import pytest
 
 from torchft_tpu.coordination import LighthouseServer
 
+# multi-process soak tier: excluded from the default run (pyproject addopts)
+pytestmark = pytest.mark.soak
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

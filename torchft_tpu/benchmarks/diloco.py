@@ -205,7 +205,7 @@ def measure_diloco(
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=len(procs)) as pool:
-            futs = [pool.submit(p.communicate, 500) for p in procs]
+            futs = [pool.submit(p.communicate, None, 500) for p in procs]
             outs = [f.result() for f in futs]
         results = []
         for p, (out, err) in zip(procs, outs):

@@ -1,0 +1,251 @@
+"""Cross-group device plane for separate-PROCESS groups sharing a slice.
+
+``CollectivesDevice`` (collectives_device.py) averages gradients over an
+elastic ``'ft'`` mesh axis, but its rendezvous is an in-process registry —
+it requires every replica group to live in ONE Python process. The
+builder's own launcher and k8s manifests put each group in its own
+process, where averaging previously fell back to the host TCP/CMA plane
+(round-3 review missing #1/#6).
+
+``CollectivesDeviceDist`` closes that gap for the one-slice topology: all
+replica-group processes join a single multi-controller JAX runtime
+(``jax.distributed``), and cross-group averaging is ONE jitted
+``shard_map``/``psum`` over a global ``'ft'`` axis spanning the
+processes — gradients never leave device memory and the reduction rides
+ICI, the role NCCL-over-NVLink plays for the reference's same-host
+process groups (process_group.py:431-447).
+
+The price of the shared runtime is STATIC membership: multi-controller
+JAX cannot lose a member and live. ``configure`` therefore validates the
+quorum cohort == the runtime cohort and raises on any mismatch — the
+supervisor then restarts the whole cohort (the k8s Indexed-Job pattern,
+launcher.py), or the caller falls back to the host plane, which is what
+the elastic path is for. Plane selection table: README "Choosing a
+cross-group data plane".
+
+Runtime bootstrap: call ``jax.distributed.initialize`` before first jax
+use (the launcher's ``--jax-coordinator`` wiring or
+``init_distributed`` below), one process per replica group.
+"""
+
+from __future__ import annotations
+
+from datetime import timedelta
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from torchft_tpu.collectives import Collectives, ReduceOp, Work
+from torchft_tpu.futures import Future
+
+__all__ = ["CollectivesDeviceDist", "init_distributed"]
+
+
+def init_distributed(
+    coordinator: str, num_processes: int, process_id: int
+) -> None:
+    """Join the shared runtime (idempotent). Must run before first jax
+    use in the process; the launcher can do this for you."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+class CollectivesDeviceDist(Collectives):
+    def __init__(self, timeout: timedelta = timedelta(seconds=60)) -> None:
+        self._timeout = timeout
+        self._rank = -1
+        self._world = 0
+        self._mesh = None
+        self._jit_cache: Dict[Tuple, Callable] = {}
+
+    # -- lifecycle --
+
+    def configure(self, store_addr: str, rank: int, world_size: int) -> None:
+        import jax
+        from jax.sharding import Mesh
+
+        if world_size == 1:
+            self._rank, self._world, self._mesh = rank, 1, None
+            return
+        if jax.process_count() != world_size or jax.process_index() != rank:
+            raise RuntimeError(
+                "CollectivesDeviceDist needs quorum cohort == runtime "
+                f"cohort: quorum says rank {rank}/{world_size}, the shared "
+                f"jax runtime says {jax.process_index()}/"
+                f"{jax.process_count()}. A shrunken quorum cannot ride a "
+                "multi-controller runtime — restart the cohort (launcher/"
+                "k8s Job) or fall back to the host plane."
+            )
+        # one device per process carries the cross-group payload; the
+        # group's inner mesh (if any) keeps using all local devices
+        devs = np.empty(world_size, dtype=object)
+        for d in jax.devices():
+            if d.process_index < world_size and devs[d.process_index] is None:
+                devs[d.process_index] = d
+        if any(d is None for d in devs):
+            raise RuntimeError("some process contributes no devices")
+        self._mesh = Mesh(devs, ("ft",))
+        self._rank = rank
+        self._world = world_size
+        self._jit_cache.clear()
+
+    def shutdown(self) -> None:
+        self._mesh = None
+
+    def size(self) -> int:
+        return self._world
+
+    def rank(self) -> int:
+        return self._rank
+
+    # -- plumbing --
+
+    def _reduce_jit(self, shape, dtype, op: ReduceOp) -> Callable:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (tuple(shape), str(dtype), op)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        mesh = self._mesh
+        world = self._world
+
+        def block(x):  # x: local [1, *shape] block
+            if op in (ReduceOp.SUM, ReduceOp.AVG):
+                r = jax.lax.psum(x, "ft")
+                if op == ReduceOp.AVG:
+                    r = r / world
+            elif op == ReduceOp.MAX:
+                r = jax.lax.pmax(x, "ft")
+            else:
+                r = jax.lax.pmin(x, "ft")
+            return r
+
+        reduced = jax.jit(
+            jax.shard_map(
+                block,
+                mesh=mesh,
+                in_specs=P("ft"),
+                out_specs=P("ft"),
+            ),
+            out_shardings=NamedSharding(mesh, P("ft")),
+        )
+        self._jit_cache[key] = reduced
+        return reduced
+
+    def _gather_jit(self, shape, dtype) -> Callable:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (tuple(shape), str(dtype), "allgather")
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        fn = jax.jit(
+            jax.shard_map(
+                lambda x: jax.lax.all_gather(x, "ft", axis=0, tiled=True),
+                mesh=self._mesh,
+                in_specs=P("ft"),
+                out_specs=P(),
+                # all_gather(tiled) IS replicated over 'ft'; the VMA
+                # checker just can't infer it through the tiled form
+                check_vma=False,
+            ),
+            out_shardings=NamedSharding(self._mesh, P()),
+        )
+        self._jit_cache[key] = fn
+        return fn
+
+    def _allreduce_one(self, arr: np.ndarray, op: ReduceOp) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self._mesh, P("ft"))
+        host = np.ascontiguousarray(arr)[None, ...]
+        garr = jax.make_array_from_process_local_data(
+            sharding, host, (self._world, *arr.shape)
+        )
+        out = self._reduce_jit(arr.shape, arr.dtype, op)(garr)
+        shard = out.addressable_shards[0].data
+        arr[...] = np.asarray(shard)[0]
+
+    # -- collectives --
+
+    def allreduce(self, arrays: List[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        try:
+            if self._world > 1:
+                for arr in arrays:
+                    self._allreduce_one(arr, op)
+            elif op == ReduceOp.AVG:
+                pass  # world 1: average of one is identity
+            return Work.completed(arrays)
+        except Exception as e:  # noqa: BLE001 — surface through the future
+            fut: Future = Future()
+            fut.set_exception(e)
+            return Work(fut)
+
+    def allgather(self, arr: np.ndarray) -> Work:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        try:
+            if self._world == 1:
+                return Work.completed([arr.copy()])
+            sharding = NamedSharding(self._mesh, P("ft"))
+            garr = jax.make_array_from_process_local_data(
+                sharding, np.ascontiguousarray(arr)[None, ...],
+                (self._world, *arr.shape),
+            )
+            gathered = self._gather_jit(arr.shape, arr.dtype)(garr)
+            local = np.asarray(gathered.addressable_shards[0].data)
+            return Work.completed([local[i] for i in range(self._world)])
+        except Exception as e:  # noqa: BLE001
+            fut: Future = Future()
+            fut.set_exception(e)
+            return Work(fut)
+
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> Work:
+        out = self.allgather(arr)
+
+        def pick(f: Future):
+            arr[...] = f.value()[root]
+            return arr
+
+        return Work(out.get_future().then(pick))
+
+    def reduce_scatter(
+        self, arrays: List[np.ndarray], op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        raise NotImplementedError(
+            "reduce_scatter is not offered on the shared-runtime plane; "
+            "use CollectivesTcp (host) for non-allreduce collectives"
+        )
+
+    def alltoall(self, arrays: List[np.ndarray]) -> Work:
+        raise NotImplementedError(
+            "alltoall is not offered on the shared-runtime plane"
+        )
+
+    def send(self, arr: np.ndarray, dst: int, tag: int = 0) -> Work:
+        raise NotImplementedError(
+            "p2p is not offered on the shared-runtime plane; checkpoint "
+            "heals ride the HTTP transport"
+        )
+
+    def recv(self, arr: np.ndarray, src: int, tag: int = 0) -> Work:
+        raise NotImplementedError(
+            "p2p is not offered on the shared-runtime plane; checkpoint "
+            "heals ride the HTTP transport"
+        )
+
+    def barrier(self) -> Work:
+        one = np.ones(1, dtype=np.float32)
+        return Work(
+            self.allreduce([one], ReduceOp.SUM).get_future().then(lambda f: None)
+        )
