@@ -46,12 +46,19 @@ b = np.zeros(3, np.float32) if gid else np.arange(3, dtype=np.float32)
 c.broadcast(b, root=0).wait()
 c.barrier().wait()
 
-# cohort mismatch must raise loudly, not deadlock
+# cohort mismatch must raise loudly, not deadlock — including a quorum
+# shrunk to ONE on this 2-process runtime (silent singleton no-op
+# allreduces would let partitioned groups diverge)
 try:
     c.configure("", gid, 3)
     mismatch = "no-error"
 except RuntimeError as e:
     mismatch = "raised"
+try:
+    c.configure("", 0, 1)
+    mismatch += "+shrunk-no-error"
+except RuntimeError:
+    mismatch += "+shrunk-raised"
 
 with open(out, "w") as f:
     json.dump({
@@ -105,4 +112,5 @@ def test_two_process_shared_runtime_allreduce(tmp_path):
     )
     assert r0["ag"] == [0.0, 1.0] and r1["ag"] == [0.0, 1.0]
     assert r0["bcast"] == [0.0, 1.0, 2.0] and r1["bcast"] == [0.0, 1.0, 2.0]
-    assert r0["mismatch"] == "raised" and r1["mismatch"] == "raised"
+    assert r0["mismatch"] == "raised+shrunk-raised", r0["mismatch"]
+    assert r1["mismatch"] == "raised+shrunk-raised", r1["mismatch"]

@@ -476,3 +476,86 @@ def test_pipelined_multibucket_averaging():
             assert isinstance(leaf, jax.Array)  # H2D already dispatched
             np.testing.assert_allclose(np.asarray(leaf), float(i + 5))
     lighthouse.shutdown()
+
+
+def test_epoch_gc_spares_previous_epoch_for_late_dialers():
+    """Adversarial GC (round-3 review weak #6): the sweep runs WHILE a
+    straggler group is still dialing the PREVIOUS epoch's rendezvous keys.
+    The one-epoch slack rule must leave epoch current-1 intact (the
+    straggler completes its mesh) while epochs <= current-2 are removed."""
+    import threading
+    import time
+    from datetime import timedelta
+
+    from torchft_tpu.collectives import CollectivesTcp, ReduceOp
+    from torchft_tpu.manager import Manager, _ManagerLogger
+    from torchft_tpu.store import StoreClient, StoreServer
+
+    store = StoreServer()
+    addr = store.address()
+    client = StoreClient(addr)
+    try:
+        # a dead epoch (3) and the previous epoch (4); current is 5
+        client.set("torchft/3/0/coll/addr/0", "stale:1")
+        client.set("torchft/3/0/coll/dpaddr/0", "stale:1")
+
+        prefix4 = f"{addr}/torchft/4/0"
+        results = {}
+
+        def straggler():
+            c = CollectivesTcp(timeout=timedelta(seconds=20), hostname="localhost")
+            try:
+                c.configure(prefix4, 1, 2)  # blocks on coll/addr/0
+                out = c.allreduce(
+                    [np.full(8, 2.0, dtype=np.float32)], ReduceOp.SUM
+                ).wait(timedelta(seconds=10))
+                results["straggler"] = float(out[0][0])
+            except Exception as e:  # noqa: BLE001
+                results["straggler"] = repr(e)
+            finally:
+                c.shutdown()
+
+        t = threading.Thread(target=straggler)
+        t.start()
+        time.sleep(0.3)  # straggler is now long-polling epoch 4's keys
+
+        # the sweep fires mid-dial (rank 0 of some group reconfiguring
+        # for epoch 5); stub carries just what the method touches
+        class _MgrStub:
+            def current_step(self):
+                return 0
+
+        class _Stub:
+            pass
+
+        stub = _Stub()
+        stub._store = client
+        stub._logger = _ManagerLogger.__new__(_ManagerLogger)
+        stub._logger._manager = _MgrStub()  # warn path needs current_step
+        stub._logger._replica_id = "gc"
+        stub._logger._rank = 0
+        Manager._sweep_stale_epochs(stub, 5)
+
+        # dead epoch gone, previous epoch still available to the straggler
+        keys = [
+            k if isinstance(k, str) else k.decode()
+            for k in client.keys("torchft/")
+        ]
+        assert not any(k.startswith("torchft/3/") for k in keys), keys
+
+        # rank 0 now arrives on epoch 4 and the mesh completes
+        c0 = CollectivesTcp(timeout=timedelta(seconds=20), hostname="localhost")
+        try:
+            c0.configure(prefix4, 0, 2)
+            out = c0.allreduce(
+                [np.full(8, 1.0, dtype=np.float32)], ReduceOp.SUM
+            ).wait(timedelta(seconds=10))
+            t.join(timeout=20)
+            assert not t.is_alive(), "straggler wedged"
+            assert results["straggler"] == 3.0, results
+            assert float(out[0][0]) == 3.0
+        finally:
+            c0.shutdown()
+    finally:
+        client.close()
+        store.shutdown()

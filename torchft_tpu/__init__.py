@@ -30,6 +30,7 @@ __all__ = [
     "Collectives",
     "CollectivesTcp",
     "CollectivesDevice",
+    "CollectivesDeviceDist",
     "CollectivesDummy",
     "ErrorSwallowingCollectives",
     "ManagedCollectives",
@@ -55,6 +56,10 @@ def __getattr__(name):
         from torchft_tpu.collectives_device import CollectivesDevice
 
         return CollectivesDevice
+    if name == "CollectivesDeviceDist":
+        from torchft_tpu.collectives_device_dist import CollectivesDeviceDist
+
+        return CollectivesDeviceDist
     if name == "FTTrainer":
         from torchft_tpu.parallel.ft import FTTrainer
 

@@ -170,6 +170,12 @@ def main() -> None:
                 "config": "2 groups × dp=4 virtual CPU devices, d256 L4 "
                 "b4 s128 f32, device-path 'ft' psum, sync quorum; "
                 "best-of-2 per variant",
+                "limitation": "CPU-mesh proxy metric: compute here is "
+                "unrealistically cheap relative to the psum, so the "
+                "overhead_pct OVERSTATES the on-chip cost (a real-TPU "
+                "2-process-per-chip session measured ~2% at r02 config); "
+                "a single-chip box cannot isolate the multi-chip "
+                "'ft'-psum cost at realistic model sizes",
             }
         ),
         flush=True,

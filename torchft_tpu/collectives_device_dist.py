@@ -11,9 +11,12 @@ process, where averaging previously fell back to the host TCP/CMA plane
 replica-group processes join a single multi-controller JAX runtime
 (``jax.distributed``), and cross-group averaging is ONE jitted
 ``shard_map``/``psum`` over a global ``'ft'`` axis spanning the
-processes — gradients never leave device memory and the reduction rides
-ICI, the role NCCL-over-NVLink plays for the reference's same-host
-process groups (process_group.py:431-447).
+processes — the cross-process reduction rides ICI, the role
+NCCL-over-NVLink plays for the reference's same-host process groups
+(process_group.py:431-447). The current API takes host numpy buffers
+(one D2H/H2D hop each side of the psum, like the host plane's bucket
+path); a device-array fast path (``device_arrays=True``) is the natural
+next step once a multi-chip box exists to measure it on.
 
 The price of the shared runtime is STATIC membership: multi-controller
 JAX cannot lose a member and live. ``configure`` therefore validates the
@@ -69,9 +72,10 @@ class CollectivesDeviceDist(Collectives):
         import jax
         from jax.sharding import Mesh
 
-        if world_size == 1:
-            self._rank, self._world, self._mesh = rank, 1, None
-            return
+        # the cohort check applies to world_size==1 too: a quorum shrunk
+        # to one on a 2-process runtime must RAISE (silently no-op
+        # allreducing alone — or two partitioned singletons diverging —
+        # is exactly what the contract forbids)
         if jax.process_count() != world_size or jax.process_index() != rank:
             raise RuntimeError(
                 "CollectivesDeviceDist needs quorum cohort == runtime "
