@@ -114,3 +114,52 @@ def test_two_process_shared_runtime_allreduce(tmp_path):
     assert r0["bcast"] == [0.0, 1.0, 2.0] and r1["bcast"] == [0.0, 1.0, 2.0]
     assert r0["mismatch"] == "raised+shrunk-raised", r0["mismatch"]
     assert r1["mismatch"] == "raised+shrunk-raised", r1["mismatch"]
+
+
+_COHORT_WORKER = r"""
+import os, sys, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, "__REPO__")
+import numpy as np
+from torchft_tpu.collectives import ReduceOp
+from torchft_tpu.collectives_device_dist import CollectivesDeviceDist, init_from_env
+
+marker, outdir = sys.argv[1], sys.argv[2]
+gid = int(os.environ["REPLICA_GROUP_ID"])
+assert init_from_env(), "cohort env missing"
+c = CollectivesDeviceDist()
+c.configure("", gid, int(os.environ["NUM_REPLICA_GROUPS"]))
+a = np.full(64, float(gid + 1), np.float32)
+c.allreduce([a], ReduceOp.AVG).wait()
+if gid == 1 and not os.path.exists(marker):
+    open(marker, "w").write("died")
+    os._exit(1)  # first attempt: die AFTER joining the runtime
+with open(os.path.join(outdir, f"g{gid}.json"), "w") as f:
+    json.dump({"v": float(a[0])}, f)
+"""
+
+
+def test_shared_runtime_cohort_restart(tmp_path):
+    """launcher --shared-runtime semantics: a worker dying after joining
+    the multi-controller runtime forces a WHOLE-cohort respawn (fresh
+    coordinator), and the respawned cohort completes."""
+    import json
+
+    from torchft_tpu.launcher import launch_shared_runtime
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_COHORT_WORKER.replace("__REPO__", REPO))
+    marker = tmp_path / "died.marker"
+    rc = launch_shared_runtime(
+        [sys.executable, str(worker), str(marker), str(tmp_path)],
+        num_groups=2,
+        max_restarts=2,
+    )
+    assert rc == 0
+    assert marker.exists()  # the first attempt really died
+    for g in range(2):
+        v = json.load(open(tmp_path / f"g{g}.json"))["v"]
+        assert v == 1.5, (g, v)  # avg of 1.0 and 2.0, identical everywhere

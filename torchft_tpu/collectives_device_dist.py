@@ -41,7 +41,7 @@ import numpy as np
 from torchft_tpu.collectives import Collectives, ReduceOp, Work
 from torchft_tpu.futures import Future
 
-__all__ = ["CollectivesDeviceDist", "init_distributed"]
+__all__ = ["CollectivesDeviceDist", "init_distributed", "init_from_env"]
 
 
 def init_distributed(
@@ -56,6 +56,24 @@ def init_distributed(
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def init_from_env() -> bool:
+    """Join the shared runtime from the launcher's cohort env contract
+    (``torchft_tpu.launcher --shared-runtime`` exports
+    TORCHFT_COHORT_COORDINATOR / _SIZE / _ID). Returns whether a cohort
+    was configured; call before first jax use."""
+    import os
+
+    coordinator = os.environ.get("TORCHFT_COHORT_COORDINATOR")
+    if not coordinator:
+        return False
+    init_distributed(
+        coordinator,
+        int(os.environ["TORCHFT_COHORT_SIZE"]),
+        int(os.environ["TORCHFT_COHORT_ID"]),
+    )
+    return True
 
 
 class CollectivesDeviceDist(Collectives):

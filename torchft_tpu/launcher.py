@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Sequence
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["launch", "main"]
+__all__ = ["launch", "launch_shared_runtime", "main"]
 
 
 @dataclass
@@ -65,6 +65,7 @@ def _spawn_group(
     nproc: int,
     lighthouse_addr: str,
     base_env: Dict[str, str],
+    cohort_env: Optional[Dict[str, str]] = None,
 ) -> _Group:
     from torchft_tpu.store import StoreServer
 
@@ -88,6 +89,8 @@ def _spawn_group(
         )
         if coordinator is not None:
             env["TORCHFT_JAX_COORDINATOR"] = coordinator
+        if cohort_env:
+            env.update(cohort_env)
         group.procs.append(subprocess.Popen(list(cmd), env=env))
     return group
 
@@ -105,6 +108,92 @@ def _teardown_group(group: _Group) -> None:
     group.store.shutdown()
 
 
+def launch_shared_runtime(
+    cmd: Sequence[str],
+    num_groups: int = 2,
+    lighthouse_addr: Optional[str] = None,
+    max_restarts: int = 10,
+) -> int:
+    """Run ``cmd`` as ``num_groups`` single-process replica groups joined
+    to ONE multi-controller JAX runtime (``CollectivesDeviceDist``: the
+    cross-group psum rides ICI). The cohort's membership is static —
+    multi-controller JAX cannot lose a member — so failure handling is
+    COHORT-grained: any worker death tears down and respawns the whole
+    cohort with a fresh coordinator (the k8s Job restart pattern), up to
+    ``max_restarts`` cohort restarts. Workers receive
+    ``TORCHFT_COHORT_COORDINATOR`` / ``TORCHFT_COHORT_SIZE`` /
+    ``TORCHFT_COHORT_ID`` and call
+    ``collectives_device_dist.init_from_env()`` before first jax use."""
+    lighthouse, lighthouse_addr = _maybe_spawn_lighthouse(
+        lighthouse_addr, num_groups
+    )
+    base_env = dict(os.environ)
+    groups: List[_Group] = []
+
+    def spawn_cohort() -> None:
+        # appends into the shared list so a spawn failure mid-cohort
+        # leaves every already-started group visible to the finally block
+        coordinator = f"localhost:{_free_port()}"
+        cohort_env = {
+            "TORCHFT_COHORT_COORDINATOR": coordinator,
+            "TORCHFT_COHORT_SIZE": str(num_groups),
+        }
+        for g in range(num_groups):
+            groups.append(
+                _spawn_group(
+                    g, cmd, num_groups, 1, lighthouse_addr, base_env,
+                    {**cohort_env, "TORCHFT_COHORT_ID": str(g)},
+                )
+            )
+
+    restarts = 0
+    exit_code = 0
+    try:
+        spawn_cohort()
+        while True:
+            time.sleep(0.5)
+            codes = [p.poll() for g in groups for p in g.procs]
+            if all(c == 0 for c in codes):
+                logger.info("cohort finished clean")
+                break
+            if any(c is not None and c != 0 for c in codes):
+                logger.warning("cohort worker died (codes %s)", codes)
+                for g in groups:
+                    _teardown_group(g)
+                groups.clear()
+                if restarts >= max_restarts:
+                    logger.error("cohort exhausted restarts")
+                    exit_code = 1
+                    break
+                restarts += 1
+                logger.info(
+                    "restarting cohort (restart %d/%d)", restarts, max_restarts
+                )
+                spawn_cohort()
+    except KeyboardInterrupt:
+        exit_code = 130
+    finally:
+        for g in groups:
+            _teardown_group(g)
+        if lighthouse is not None:
+            lighthouse.shutdown()
+    return exit_code
+
+
+def _maybe_spawn_lighthouse(lighthouse_addr: Optional[str], min_replicas: int):
+    """Launcher-owned lighthouse when no external address was given;
+    returns (server_or_None, host:port)."""
+    if lighthouse_addr is not None:
+        return None, lighthouse_addr
+    from torchft_tpu.coordination import LighthouseServer
+
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=min_replicas)
+    # address() is http://host:port — the env var carries host:port
+    addr = lighthouse.address().split("//", 1)[-1]
+    logger.info("spawned lighthouse at %s", addr)
+    return lighthouse, addr
+
+
 def launch(
     cmd: Sequence[str],
     num_groups: int = 2,
@@ -116,16 +205,9 @@ def launch(
     """Run ``cmd`` as ``num_groups`` fault-tolerant replica groups of
     ``nproc`` workers. Returns the exit code (0 iff every group finished
     clean)."""
-    lighthouse = None
-    if lighthouse_addr is None:
-        from torchft_tpu.coordination import LighthouseServer
-
-        lighthouse = LighthouseServer(
-            bind="[::]:0", min_replicas=min_replicas or num_groups
-        )
-        # address() is http://host:port — the env var carries host:port
-        lighthouse_addr = lighthouse.address().split("//", 1)[-1]
-        logger.info("spawned lighthouse at %s", lighthouse_addr)
+    lighthouse, lighthouse_addr = _maybe_spawn_lighthouse(
+        lighthouse_addr, min_replicas or num_groups
+    )
 
     base_env = dict(os.environ)
     groups = [
@@ -264,6 +346,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     parser.add_argument("--max-restarts", type=int, default=10)
     parser.add_argument("--min-replicas", type=int, default=None)
     parser.add_argument(
+        "--shared-runtime",
+        action="store_true",
+        help="join all groups to ONE multi-controller jax runtime "
+        "(CollectivesDeviceDist: cross-group psum rides ICI). Cohort-"
+        "grained restarts; requires --nproc 1",
+    )
+    parser.add_argument(
         "--emit-k8s",
         action="store_true",
         help="print Kubernetes manifests for this topology instead of "
@@ -292,6 +381,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         parser.error("no command given (use: launcher [opts] -- cmd ...)")
     logging.basicConfig(level=logging.INFO)
     if args.emit_k8s:
+        if args.shared_runtime:
+            parser.error("--emit-k8s does not support --shared-runtime yet: "
+                         "the manifests would lack the TORCHFT_COHORT_* "
+                         "wiring and workers would silently fall back to "
+                         "per-group runtimes")
         from torchft_tpu.k8s import emit_manifests
 
         print(
@@ -312,6 +406,21 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         return
     if args.k8s_worker:
         sys.exit(k8s_worker(cmd))
+    if args.shared_runtime:
+        if args.nproc != 1:
+            parser.error("--shared-runtime requires --nproc 1 (one jax "
+                         "runtime per process)")
+        if args.min_replicas is not None:
+            parser.error("--shared-runtime is cohort-grained: membership "
+                         "is static, --min-replicas does not apply")
+        sys.exit(
+            launch_shared_runtime(
+                cmd,
+                num_groups=args.groups,
+                lighthouse_addr=args.lighthouse,
+                max_restarts=args.max_restarts,
+            )
+        )
     sys.exit(
         launch(
             cmd,
