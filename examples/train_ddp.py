@@ -10,6 +10,9 @@ Mesh — see torchft_tpu.parallel). Configure via env:
     STEPS=20                       steps to train
     CKPT_DIR=/path                 enable periodic disk checkpoints there
     CKPT_EVERY=5                   checkpoint cadence (committed steps)
+    DATA_PLANE=tcp|device-dist     cross-group backend (device-dist needs
+                                   launcher --shared-runtime: one
+                                   multi-controller runtime, psum on ICI)
 
 Run a 2-group session (3 terminals)::
 
@@ -106,8 +109,30 @@ def main() -> None:
 
     initialize_group()
 
+    # DATA_PLANE=tcp (default): host ring with the native striped/CMA
+    # fast path. DATA_PLANE=device-dist: all groups share ONE
+    # multi-controller jax runtime (launcher --shared-runtime) and the
+    # averaging psum rides ICI — see README's plane-selection table.
+    if os.environ.get("DATA_PLANE", "tcp") == "device-dist":
+        from torchft_tpu.collectives_device_dist import (
+            CollectivesDeviceDist,
+            init_from_env,
+        )
+
+        if not init_from_env():
+            raise SystemExit(
+                "DATA_PLANE=device-dist requires the shared-runtime cohort "
+                "env (run under `python -m torchft_tpu.launcher "
+                "--shared-runtime`); without it every group would form its "
+                "own 1-process runtime and quorum configure() would reject "
+                "the cohort mismatch on every epoch"
+            )
+        collectives = CollectivesDeviceDist(timeout=timedelta(seconds=30))
+    else:
+        collectives = CollectivesTcp(timeout=timedelta(seconds=30))
+
     manager = Manager(
-        collectives=CollectivesTcp(timeout=timedelta(seconds=30)),
+        collectives=collectives,
         load_state_dict=None,  # wired by ManagedOptimizer.init
         state_dict=None,
         min_replica_size=min(2, num_groups),

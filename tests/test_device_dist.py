@@ -163,3 +163,41 @@ def test_shared_runtime_cohort_restart(tmp_path):
     for g in range(2):
         v = json.load(open(tmp_path / f"g{g}.json"))["v"]
         assert v == 1.5, (g, v)  # avg of 1.0 and 2.0, identical everywhere
+
+
+def test_train_ddp_over_shared_runtime(tmp_path):
+    """The full Manager FT loop (quorum + commit + ManagedOptimizer) with
+    CollectivesDeviceDist as the data plane: 2 groups under
+    launcher --shared-runtime must finish with bit-identical params."""
+    import re
+
+    from torchft_tpu.launcher import launch_shared_runtime
+
+    wrapper = tmp_path / "wrap.sh"
+    wrapper.write_text(
+        "#!/bin/bash\n"
+        f"cd {REPO}\n"
+        f"exec {sys.executable} examples/train_ddp.py > "
+        f"{tmp_path}/g${{REPLICA_GROUP_ID}}.log 2>&1\n"
+    )
+    wrapper.chmod(0o755)
+    env_save = dict(os.environ)
+    os.environ.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        DATA_PLANE="device-dist",
+        STEPS="20",
+    )
+    try:
+        rc = launch_shared_runtime([str(wrapper)], num_groups=2, max_restarts=1)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_save)
+    assert rc == 0
+    sums = []
+    for g in range(2):
+        text = (tmp_path / f"g{g}.log").read_text()
+        m = re.findall(r"param_checksum=(-?\d+\.\d+)", text)
+        assert m, text[-2000:]
+        sums.append(m[-1])
+    assert sums[0] == sums[1], sums

@@ -78,6 +78,11 @@ def init_from_env() -> bool:
 
 class CollectivesDeviceDist(Collectives):
     def __init__(self, timeout: timedelta = timedelta(seconds=60)) -> None:
+        # Per-op deadlines cannot interrupt a compiled collective; on this
+        # plane LIVENESS is the shared runtime's own job (jax.distributed
+        # heartbeats kill the cohort when a member wedges, and the
+        # launcher's cohort supervision respawns it). The timeout arg is
+        # kept for Collectives-API symmetry only.
         self._timeout = timeout
         self._rank = -1
         self._world = 0
