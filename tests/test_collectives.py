@@ -624,3 +624,73 @@ class TestNativePlane:
         assert outs[1] == "died"
         assert outs[0][0] == "gone", outs[0]
         assert outs[0][1] == 1
+
+
+class TestCmaP2P:
+    """Round-4 p2p CMA fast path: frames >= TORCHFT_CMA_P2P_MIN ship a
+    pull descriptor instead of streaming bytes (heal transfers at memcpy
+    class speed). The in-process fixture ranks share a pid, so the CMA
+    negotiation arms the path."""
+
+    def test_large_send_recv_roundtrip(self, store, monkeypatch):
+        monkeypatch.setenv("TORCHFT_CMA_P2P_MIN", str(64 * 1024))
+        n = 1 << 18  # 1 MB of f32 — above the lowered threshold
+
+        def fn(c, rank):
+            assert c.plane_info() == "cma"
+            if rank == 0:
+                payload = np.arange(n, dtype=np.float32)
+                c.send(payload, dst=1, tag=77).wait(timedelta(seconds=20))
+                return payload[:4].copy()
+            buf = np.zeros(n, dtype=np.float32)
+            c.recv(buf, src=0, tag=77).wait(timedelta(seconds=20))
+            return buf[:4].copy()
+
+        outs = _run_world(store, 2, fn, prefix="cmap2p")
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+    def test_large_frame_for_other_tag_is_stashed(self, store, monkeypatch):
+        """A CMA descriptor for a tag nobody is waiting on yet must be
+        pulled immediately (the sender's buffer is parked until the ack)
+        and stashed for the later recv."""
+        monkeypatch.setenv("TORCHFT_CMA_P2P_MIN", str(64 * 1024))
+        n = 1 << 16  # 256 KB
+
+        def fn(c, rank):
+            if rank == 0:
+                c.send(np.full(n, 7.0, np.float32), dst=1, tag=22).wait(
+                    timedelta(seconds=20)
+                )
+                c.send(np.full(n, 5.0, np.float32), dst=1, tag=11).wait(
+                    timedelta(seconds=20)
+                )
+                return None
+            a = np.zeros(n, np.float32)
+            b = np.zeros(n, np.float32)
+            wa = c.recv(a, src=0, tag=11)
+            wb = c.recv(b, src=0, tag=22)
+            wa.wait(timedelta(seconds=20))
+            wb.wait(timedelta(seconds=20))
+            return float(a[0]), float(b[0])
+
+        outs = _run_world(store, 2, fn, prefix="cmastash")
+        assert outs[1] == (5.0, 7.0)
+
+    def test_checkpoint_transport_rides_cma(self, store, monkeypatch):
+        monkeypatch.setenv("TORCHFT_CMA_P2P_MIN", str(64 * 1024))
+        from torchft_tpu.checkpointing.collectives_transport import (
+            CollectivesTransport,
+        )
+
+        state = {"w": np.random.default_rng(3).standard_normal(1 << 18).astype(np.float32)}
+
+        def fn(c, rank):
+            t = CollectivesTransport(c, timeout=timedelta(seconds=20))
+            if rank == 0:
+                t.send_checkpoint([1], 0, state, timedelta(seconds=20))
+                return None
+            got = t.recv_checkpoint(0, t.metadata(), 0, timedelta(seconds=20))
+            return np.asarray(got["w"])
+
+        outs = _run_world(store, 2, fn, prefix="cmaheal")
+        np.testing.assert_array_equal(outs[1], state["w"])

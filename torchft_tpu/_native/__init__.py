@@ -304,12 +304,52 @@ class _iovec(ctypes.Structure):
     _fields_ = [("iov_base", ctypes.c_void_p), ("iov_len", ctypes.c_size_t)]
 
 
+def _libc() -> ctypes.CDLL:
+    global _LIBC
+    if _LIBC is None:
+        _LIBC = ctypes.CDLL("libc.so.6", use_errno=True)
+        # ssize_t return: the default c_int would truncate >=2GiB pulls
+        # into spurious errors or wrong offset advances
+        _LIBC.process_vm_readv.restype = ctypes.c_ssize_t
+        _LIBC.process_vm_readv.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(_iovec),
+            ctypes.c_ulong,
+            ctypes.POINTER(_iovec),
+            ctypes.c_ulong,
+            ctypes.c_ulong,
+        ]
+    return _LIBC
+
+
+_LIBC: "ctypes.CDLL | None" = None
+
+
+def cma_read_into(pid: int, addr: int, view: memoryview) -> None:
+    """process_vm_readv ``len(view)`` bytes from ``pid``'s address space
+    straight into the writable buffer ``view`` (single copy — the p2p CMA
+    fast path's pull primitive). Raises OSError when the kernel says no."""
+    libc = _libc()
+    n = len(view)
+    buf = (ctypes.c_char * n).from_buffer(view)
+    off = 0
+    while off < n:
+        local = _iovec(ctypes.addressof(buf) + off, n - off)
+        remote = _iovec(addr + off, n - off)
+        got = libc.process_vm_readv(
+            pid, ctypes.byref(local), 1, ctypes.byref(remote), 1, 0
+        )
+        if got <= 0:
+            raise OSError(ctypes.get_errno(), "process_vm_readv failed")
+        off += got
+
+
 def cma_read(pid: int, addr: int, n: int) -> bytes:
     """One process_vm_readv of ``n`` bytes from ``pid``'s address space —
     the rendezvous probe for the CMA transport (a token round-trip proves
     the published pid is addressable from THIS pid namespace and ptrace
     policy allows the attach). Raises OSError when the kernel says no."""
-    libc = ctypes.CDLL("libc.so.6", use_errno=True)
+    libc = _libc()
     buf = ctypes.create_string_buffer(n)
     local = _iovec(ctypes.addressof(buf), n)
     remote = _iovec(addr, n)

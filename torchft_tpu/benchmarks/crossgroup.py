@@ -90,6 +90,107 @@ def _raw_worker_main(argv: List[str]) -> None:
     c.shutdown()
 
 
+def _heal_worker_main(argv: List[str]) -> None:
+    """Checkpoint-heal throughput: rank 0 serves a 256MB-class state over
+    CollectivesTransport, rank 1 receives (the live-heal data path). With
+    the p2p CMA fast path the payload is pulled at memcpy-class speed."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gid", type=int, required=True)
+    parser.add_argument("--store", required=True)
+    parser.add_argument("--total-mb", type=float, required=True)
+    args = parser.parse_args(argv)
+
+    from datetime import timedelta
+
+    import numpy as np
+
+    from torchft_tpu.checkpointing.collectives_transport import (
+        CollectivesTransport,
+    )
+    from torchft_tpu.collectives import CollectivesTcp
+
+    n = int(args.total_mb * 1024 * 1024 / 4 / 8)
+    state = {
+        f"w{i}": np.random.default_rng(i).standard_normal(n).astype(np.float32)
+        for i in range(8)
+    }
+    c = CollectivesTcp(timeout=timedelta(seconds=120), hostname="localhost")
+    c.configure(args.store, args.gid, 2)
+    t = CollectivesTransport(c, timeout=timedelta(seconds=120))
+    if args.gid == 0:
+        t.send_checkpoint([1], 0, state, timedelta(seconds=120))
+        print(json.dumps({"gid": 0, "plane": c.plane_info()}), flush=True)
+    else:
+        t0 = time.perf_counter()
+        got = t.recv_checkpoint(0, t.metadata(), 0, timedelta(seconds=120))
+        dt = time.perf_counter() - t0
+        ok = bool(
+            np.array_equal(np.asarray(got["w0"]), state["w0"])
+        )
+        print(
+            json.dumps(
+                {
+                    "gid": 1,
+                    "seconds": dt,
+                    "total_bytes": n * 8 * 4,
+                    "ok": ok,
+                    "plane": c.plane_info(),
+                }
+            ),
+            flush=True,
+        )
+    c.shutdown()
+
+
+def _run_heal_pair(total_mb: float, env_extra: Dict[str, str]) -> Dict[str, object]:
+    from torchft_tpu.store import StoreServer
+
+    store = StoreServer()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra)
+    procs = []
+    try:
+        for gid in range(2):
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        "-m",
+                        "torchft_tpu.benchmarks.crossgroup",
+                        "--heal-worker",
+                        "--gid",
+                        str(gid),
+                        "--store",
+                        store.address(),
+                        "--total-mb",
+                        str(total_mb),
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    env=env,
+                )
+            )
+        results = []
+        for p in procs:
+            out, err = p.communicate(timeout=500)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"heal worker rc={p.returncode}: {err.decode()[-2000:]}"
+                )
+            results.append(json.loads(out.decode().strip().splitlines()[-1]))
+    finally:
+        store.shutdown()
+    r = next(r for r in results if r["gid"] == 1)
+    assert r["ok"], "heal payload corrupted"
+    return {
+        "seconds": round(r["seconds"], 4),
+        "gb_per_sec": round(r["total_bytes"] / r["seconds"] / 1e9, 3),
+        "plane": r["plane"],
+    }
+
+
 def _worker_main(argv: List[str]) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--gid", type=int, required=True)
@@ -339,6 +440,17 @@ def measure_crossgroup(
         del res["total_bytes"]
         out[name] = res
 
+    # live-heal throughput (the rejoin data path) with and without the
+    # p2p CMA fast path
+    for name, env_extra in (
+        ("heal_cma", {}),
+        ("heal_tcp", {"TORCHFT_DP_CMA": "0"}),
+    ):
+        try:
+            out[name] = _run_heal_pair(total_mb, env_extra)
+        except Exception as e:  # noqa: BLE001 — best-effort matrix row
+            out[name] = {"error": str(e)}
+
     variants = {
         "serial_r2": dict(wire_dtype="", serial=True),
         "pipelined": dict(wire_dtype="", serial=False),
@@ -374,6 +486,10 @@ def measure_crossgroup(
 
 
 def main() -> None:
+    if "--heal-worker" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--heal-worker"]
+        _heal_worker_main(argv)
+        return
     if "--raw-worker" in sys.argv:
         argv = [a for a in sys.argv[1:] if a != "--raw-worker"]
         _raw_worker_main(argv)

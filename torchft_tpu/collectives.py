@@ -170,6 +170,36 @@ class Collectives(ABC):
 _HELLO_MAGIC = 0x7F7A0001
 _FRAME_HDR = struct.Struct("<II")  # (tag, length) — tag catches desync bugs
 
+# CMA fast path for LARGE p2p frames when the data-plane probe proved the
+# peers same-host: instead of streaming the payload, the sender ships a
+# 16-byte {addr, nbytes} descriptor (tag | _CMA_FLAG) and the receiver
+# pulls the bytes straight out of the sender's address space
+# (process_vm_readv), then acks (tag | _ACK_FLAG) so the sender may reuse
+# the buffer. This is what lifts checkpoint heals and other big p2p
+# transfers to memcpy-class speed on one host. The top two tag bits are
+# reserved for the protocol — structurally safe: public send/recv mask
+# user tags to 24 bits and every internal tag space tops out at
+# 0x0DFFFFFF.
+_CMA_FLAG = 0x80000000
+_ACK_FLAG = 0x40000000
+_CMA_DESC = struct.Struct("<QQ")  # (addr, nbytes)
+
+
+def _cma_p2p_min() -> int:
+    import os
+
+    try:
+        return int(os.environ.get("TORCHFT_CMA_P2P_MIN", str(1 << 20)))
+    except ValueError:
+        return 1 << 20
+
+
+def _cma_pull(pid: int, addr: int, view: memoryview) -> None:
+    """process_vm_readv the peer's [addr, addr+len) into ``view``."""
+    from torchft_tpu._native import cma_read_into
+
+    cma_read_into(pid, addr, view)
+
 
 def _send_frame(sock: socket.socket, tag: int, payload: memoryview) -> None:
     sock.sendall(_FRAME_HDR.pack(tag, len(payload)))
@@ -280,6 +310,11 @@ class CollectivesTcp(Collectives):
         self._native_plane = native_plane
         self._dp_stripes = max(1, dp_stripes)
         self._dp = None  # NativeDataPlane for the current epoch
+        self._dp_cma_pids: Optional[List[int]] = None  # p2p CMA fast path
+        self._cma_p2p_min = _cma_p2p_min()  # resolved once, not per frame
+        # buffers whose pull-ack never arrived: parked until teardown so a
+        # dangling descriptor can never be pulled against reused memory
+        self._cma_quarantine: List[np.ndarray] = []
         self._death_watch_cb: Optional[Callable[[int], None]] = None
         self._timeout = timeout
         self._hostname = hostname or socket.gethostname()
@@ -518,6 +553,7 @@ class CollectivesTcp(Collectives):
         if all_ok:
             dp.enable_cma(pids)
             self._dp_cma = True
+            self._dp_cma_pids = pids  # arms the p2p CMA fast path too
             logger.info(
                 "data plane: CMA transport enabled (%d ranks, one host)",
                 world_size,
@@ -617,6 +653,9 @@ class CollectivesTcp(Collectives):
             # unblocks an op thread parked inside the native allreduce
             self._dp.close()
             self._dp = None
+        self._dp_cma_pids = None
+        # sockets are closed: no dangling descriptor can be consumed now
+        self._cma_quarantine.clear()
         if self._executor is not None:
             self._executor.shutdown(wait=True, cancel_futures=True)
             self._executor = None
@@ -676,6 +715,13 @@ class CollectivesTcp(Collectives):
         return Work(out)
 
     def _send_to(self, rank: int, tag: int, data: memoryview) -> None:
+        if (
+            self._dp_cma_pids is not None
+            and len(data) >= self._cma_p2p_min
+            and not (tag & (_CMA_FLAG | _ACK_FLAG))
+        ):
+            self._send_cma(rank, tag, data)
+            return
         p = self._peer(rank)
         try:
             with p.send_lock:
@@ -684,6 +730,45 @@ class CollectivesTcp(Collectives):
             if isinstance(e, (socket.timeout, TimeoutError)):
                 raise  # slow-but-alive peer: latch the error, don't accuse
             raise PeerGoneError(rank, f"send to peer {rank} failed: {e}") from e
+
+    def _send_cma(self, rank: int, tag: int, data: memoryview) -> None:
+        """Ship a pull descriptor instead of the payload; the buffer must
+        stay untouched until the peer's ack (awaited here) confirms the
+        pull completed."""
+        arr = np.frombuffer(data, dtype=np.uint8)
+        desc = _CMA_DESC.pack(arr.ctypes.data, len(data))
+        p = self._peer(rank)
+        try:
+            with p.send_lock:
+                _send_frame(p.sock, tag | _CMA_FLAG, memoryview(desc))
+        except (ConnectionError, OSError) as e:
+            if isinstance(e, (socket.timeout, TimeoutError)):
+                raise
+            raise PeerGoneError(rank, f"send to peer {rank} failed: {e}") from e
+        # the ack rides the normal tag-matched machinery (interleaves
+        # safely with any concurrent traffic on this socket)
+        try:
+            self._recv_from(rank, tag | _ACK_FLAG)
+        except TimeoutError as e:
+            # The descriptor is DANGLING: the peer may still pull that
+            # address later. A retryable timeout here would let the caller
+            # reuse/free the memory and hand the peer silently corrupt
+            # bytes (the TCP path streamed a copy and never had this
+            # hazard). Quarantine the buffer for the rest of the epoch and
+            # poison the stream so both sides reconfigure.
+            self._cma_quarantine.append(arr)
+            with p.cond:
+                p.recv_error = e
+                p.cond.notify_all()
+            try:
+                p.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            raise ConnectionError(
+                f"CMA pull-ack from peer {rank} timed out; epoch poisoned "
+                f"(descriptor quarantined)"
+            ) from e
+        del arr  # keep the source buffer alive until the ack
 
     def _recv_from(
         self, rank: int, tag: int, into: Optional[memoryview] = None
@@ -694,14 +779,14 @@ class CollectivesTcp(Collectives):
         returned."""
         p = self._peer(rank)
         try:
-            return self._recv_matched(p, tag, into)
+            return self._recv_matched(p, rank, tag, into)
         except (ConnectionError, OSError) as e:
             if isinstance(e, (socket.timeout, TimeoutError)):
                 raise  # slow-but-alive peer: latch the error, don't accuse
             raise PeerGoneError(rank, f"recv from peer {rank} failed: {e}") from e
 
     def _recv_matched(
-        self, p: _Peer, tag: int, into: Optional[memoryview]
+        self, p: _Peer, rank: int, tag: int, into: Optional[memoryview]
     ) -> Optional[bytearray]:
         """Core of the concurrent-safe receive path.
 
@@ -764,7 +849,30 @@ class CollectivesTcp(Collectives):
             try:
                 hdr = _recv_exact(p.sock, _FRAME_HDR.size)
                 got_tag, length = _FRAME_HDR.unpack(bytes(hdr))
-                if got_tag == tag and into is not None and len(into) == length:
+                if got_tag & _CMA_FLAG:
+                    # pull descriptor: fetch the payload from the sender's
+                    # address space, then ack so it may reuse the buffer
+                    got_tag &= ~_CMA_FLAG
+                    desc = _recv_exact(p.sock, length)
+                    addr, nbytes = _CMA_DESC.unpack(bytes(desc))
+                    pids = self._dp_cma_pids  # teardown may None the field
+                    if pids is None:
+                        raise ConnectionError(
+                            "CMA descriptor arrived during teardown"
+                        )
+                    pid = pids[rank]
+                    if (
+                        got_tag == tag
+                        and into is not None
+                        and len(into) == nbytes
+                    ):
+                        _cma_pull(pid, addr, into)
+                        filled = True
+                    else:
+                        data = bytearray(nbytes)
+                        _cma_pull(pid, addr, memoryview(data))
+                    self._send_to(rank, got_tag | _ACK_FLAG, memoryview(b""))
+                elif got_tag == tag and into is not None and len(into) == length:
                     _recv_exact_into(p.sock, into)
                     filled = True
                 else:
