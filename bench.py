@@ -359,6 +359,22 @@ def main() -> None:
             else None,
             "attention": attn_note,
         }
+        # s=32k at FULL depth: the memory-ceiling config that previously
+        # fit only the latency-bound pallas path at L<=2 (best-effort: the
+        # tunnel kills any single on-chip program past ~60s)
+        try:
+            xk_sps, _ = train_bench(cfg, 1, 32768, 2, 1, averaging=True)
+            xk_flops = _model_flops_per_step(cfg, n_params, 1, 32768)
+            extra["long_context_s32768"] = {
+                "steps_per_sec": round(xk_sps, 4),
+                "tokens_per_sec": round(xk_sps * 32768),
+                "mfu_pct": round(xk_sps * xk_flops / peak * 100.0, 2)
+                if peak
+                else None,
+                "attention": attn_note,
+            }
+        except Exception as e:  # noqa: BLE001
+            extra["long_context_s32768"] = {"error": str(e)}
 
     # scale variant (TPU only): the d512 headline model is small enough to
     # be dispatch/attention-bound; at 647M params the same FT loop shows
