@@ -185,13 +185,32 @@ _ACK_FLAG = 0x40000000
 _CMA_DESC = struct.Struct("<QQ")  # (addr, nbytes)
 
 
-def _cma_p2p_min() -> int:
+def _env_int(name: str, default: int) -> int:
+    """Guarded env knob parse: a typo'd deploy config must fall back, not
+    crash the worker at construction."""
     import os
 
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
     try:
-        return int(os.environ.get("TORCHFT_CMA_P2P_MIN", str(1 << 20)))
+        return int(raw)
     except ValueError:
-        return 1 << 20
+        logger.warning("ignoring malformed %s=%r; using %d", name, raw, default)
+        return default
+
+
+def _cma_p2p_min() -> int:
+    return _env_int("TORCHFT_CMA_P2P_MIN", 1 << 20)
+
+
+# Buffers whose pull-ack never arrived. PROCESS-GLOBAL and never dropped:
+# process_vm_readv needs no socket, so a peer that already holds the
+# descriptor in its kernel recv buffer can pull long after this epoch's
+# sockets closed — the memory must stay pinned for the process lifetime.
+# Growth is bounded by ack-failure events (rare); size is logged so a
+# pathological loop is operator-visible.
+_CMA_QUARANTINE: List[np.ndarray] = []
 
 
 def _cma_pull(pid: int, addr: int, view: memoryview) -> None:
@@ -306,15 +325,12 @@ class CollectivesTcp(Collectives):
         if native_plane is None:
             native_plane = _os.environ.get("TORCHFT_NATIVE_PLANE", "1") != "0"
         if dp_stripes is None:
-            dp_stripes = int(_os.environ.get("TORCHFT_DP_STRIPES", "4"))
+            dp_stripes = _env_int("TORCHFT_DP_STRIPES", 4)
         self._native_plane = native_plane
         self._dp_stripes = max(1, dp_stripes)
         self._dp = None  # NativeDataPlane for the current epoch
         self._dp_cma_pids: Optional[List[int]] = None  # p2p CMA fast path
         self._cma_p2p_min = _cma_p2p_min()  # resolved once, not per frame
-        # buffers whose pull-ack never arrived: parked until teardown so a
-        # dangling descriptor can never be pulled against reused memory
-        self._cma_quarantine: List[np.ndarray] = []
         self._death_watch_cb: Optional[Callable[[int], None]] = None
         self._timeout = timeout
         self._hostname = hostname or socket.gethostname()
@@ -515,8 +531,11 @@ class CollectivesTcp(Collectives):
         import os
         import secrets
 
-        if os.environ.get("TORCHFT_DP_CMA", "1") == "0":
-            return
+        # An opted-out rank STILL publishes its keys (with ok="0"): peers
+        # that did not opt out would otherwise block their whole rendezvous
+        # deadline on keys that never appear, failing configure on every
+        # epoch instead of settling on TCP in one round.
+        opt_out = os.environ.get("TORCHFT_DP_CMA", "1") == "0"
         from torchft_tpu._native import cma_read
 
         token = secrets.token_bytes(16)
@@ -529,15 +548,21 @@ class CollectivesTcp(Collectives):
         )
         left = (rank - 1) % world_size
         ok = False
-        try:
-            ent = self._store.get(
-                f"coll/dpcma/{left}", timeout=remaining()
-            ).decode()
-            lhost, lpid, ltok, laddr = ent.split("|")
-            if lhost == self._hostname:
-                ok = cma_read(int(lpid), int(laddr), 16) == bytes.fromhex(ltok)
-        except Exception as e:  # noqa: BLE001 — any failure means TCP
-            logger.info("CMA probe of rank %d failed (%s); staying on TCP", left, e)
+        if not opt_out:
+            try:
+                ent = self._store.get(
+                    f"coll/dpcma/{left}", timeout=remaining()
+                ).decode()
+                lhost, lpid, ltok, laddr = ent.split("|")
+                if lhost == self._hostname:
+                    ok = (
+                        cma_read(int(lpid), int(laddr), 16)
+                        == bytes.fromhex(ltok)
+                    )
+            except Exception as e:  # noqa: BLE001 — any failure means TCP
+                logger.info(
+                    "CMA probe of rank %d failed (%s); staying on TCP", left, e
+                )
         self._store.set(f"coll/dpcmaok/{rank}", "1" if ok else "0")
         pids = []
         all_ok = True
@@ -654,8 +679,6 @@ class CollectivesTcp(Collectives):
             self._dp.close()
             self._dp = None
         self._dp_cma_pids = None
-        # sockets are closed: no dangling descriptor can be consumed now
-        self._cma_quarantine.clear()
         if self._executor is not None:
             self._executor.shutdown(wait=True, cancel_futures=True)
             self._executor = None
@@ -749,14 +772,22 @@ class CollectivesTcp(Collectives):
         # safely with any concurrent traffic on this socket)
         try:
             self._recv_from(rank, tag | _ACK_FLAG)
-        except TimeoutError as e:
-            # The descriptor is DANGLING: the peer may still pull that
-            # address later. A retryable timeout here would let the caller
-            # reuse/free the memory and hand the peer silently corrupt
-            # bytes (the TCP path streamed a copy and never had this
-            # hazard). Quarantine the buffer for the rest of the epoch and
-            # poison the stream so both sides reconfigure.
-            self._cma_quarantine.append(arr)
+        except BaseException as e:
+            # ANY failure to observe the ack leaves the descriptor
+            # DANGLING: the peer may still pull that address later (it
+            # needs no socket for the pull, only the 16 descriptor bytes
+            # it may already hold). Letting the caller reuse/free the
+            # memory would hand the peer silently corrupt bytes — the TCP
+            # path streamed a copy and never had this hazard. Pin the
+            # buffer for the PROCESS lifetime and poison the stream so
+            # both sides reconfigure.
+            _CMA_QUARANTINE.append(arr)
+            q_bytes = sum(a.nbytes for a in _CMA_QUARANTINE)
+            logger.warning(
+                "CMA pull-ack from peer %d failed (%s); buffer quarantined "
+                "(%d buffers, %.1f MB pinned process-wide)",
+                rank, e, len(_CMA_QUARANTINE), q_bytes / 1e6,
+            )
             with p.cond:
                 p.recv_error = e
                 p.cond.notify_all()
@@ -764,10 +795,12 @@ class CollectivesTcp(Collectives):
                 p.sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
-            raise ConnectionError(
-                f"CMA pull-ack from peer {rank} timed out; epoch poisoned "
-                f"(descriptor quarantined)"
-            ) from e
+            if isinstance(e, TimeoutError):
+                raise ConnectionError(
+                    f"CMA pull-ack from peer {rank} timed out; epoch "
+                    f"poisoned (descriptor quarantined)"
+                ) from e
+            raise
         del arr  # keep the source buffer alive until the ack
 
     def _recv_from(

@@ -224,6 +224,7 @@ class Manager:
         self._errored: Optional[Exception] = None
         self._errored_epoch = -1  # quorum_id whose plane produced _errored
         self._step_epochs: set = set()  # quorum_ids this step's ops ran on
+        self._step_n: Optional[int] = None  # issue-time participant count
 
         # Active failure detection: the data plane's sockets learn about a
         # dead peer (FIN/RST) within milliseconds — long before the next
@@ -277,6 +278,7 @@ class Manager:
         self._healing = False
         self._group_healing = False
         self._step_epochs = set()
+        self._step_n = None
 
         # hold the lock across wait+replace: a death-watch submission
         # sliding in between would be silently overwritten (its exception
@@ -505,6 +507,12 @@ class Manager:
         # re-quorum future while its configure() waits to join that very
         # thread is a cycle.
         n_at_issue = self._participating_world_size
+        # ... and the COMMIT accounting must use the same snapshot: a
+        # death-watch re-quorum landing between the step's last op and
+        # should_commit would otherwise count the new cohort's size for
+        # batches averaged over the old one (or veto on the new cohort's
+        # min_replicas when the reduction was over enough replicas)
+        self._step_n = n_at_issue
 
         # branch on the *configured* data plane, not the input type: the
         # device backend converts numpy inputs to jax.Arrays, so its results
@@ -710,7 +718,14 @@ class Manager:
         if self._healing:
             self._apply_pending_state_dict()
 
-        enough_replicas = self.num_participants() >= self._min_replica_size
+        # membership as of the step's OPS (issue-time snapshot), not of a
+        # death-watch re-quorum that may have landed after them
+        n_step = (
+            self._step_n
+            if getattr(self, "_step_n", None) is not None
+            else self.num_participants()
+        )
+        enough_replicas = n_step >= self._min_replica_size
         # a step whose collectives spanned two plane epochs (death-watch
         # re-quorum mid-step) mixed normalization denominators — every
         # rank sees the same span, so the veto is group-consistent
@@ -742,7 +757,7 @@ class Manager:
 
         if should_commit:
             self._step += 1
-            self._batches_committed += self.num_participants()
+            self._batches_committed += n_step
         return should_commit
 
     # ------------------------------------------------------------------
