@@ -694,3 +694,36 @@ class TestCmaP2P:
 
         outs = _run_world(store, 2, fn, prefix="cmaheal")
         np.testing.assert_array_equal(outs[1], state["w"])
+
+    def test_ack_timeout_quarantines_and_poisons(self, store, monkeypatch):
+        """If the pull-ack never arrives, the sender must pin the buffer
+        process-wide (a dangling descriptor may still be pulled later) and
+        poison the stream — never surface a retryable timeout that lets
+        the caller reuse the memory."""
+        monkeypatch.setenv("TORCHFT_CMA_P2P_MIN", str(64 * 1024))
+        import time
+
+        import torchft_tpu.collectives as C
+
+        before = len(C._CMA_QUARANTINE)
+        n = 1 << 16
+
+        def fn(c, rank):
+            if rank == 1:
+                time.sleep(3.0)  # never posts the recv inside the timeout
+                return "slept"
+            payload = np.full(n, 3.0, np.float32)
+            try:
+                c.send(payload, dst=1, tag=33).wait(timedelta(seconds=8))
+                return "sent"
+            except Exception as e:  # noqa: BLE001
+                return type(e).__name__
+
+        outs = _run_world(
+            store, 2, fn, prefix="cmaq", timeout=timedelta(seconds=1)
+        )
+        assert outs[1] == "slept"
+        # the send failed terminally (poisoned epoch), not retryably
+        assert outs[0] in ("PeerGoneError", "ConnectionError"), outs
+        assert len(C._CMA_QUARANTINE) == before + 1
+        assert C._CMA_QUARANTINE[-1].nbytes == n * 4

@@ -721,9 +721,7 @@ class Manager:
         # membership as of the step's OPS (issue-time snapshot), not of a
         # death-watch re-quorum that may have landed after them
         n_step = (
-            self._step_n
-            if getattr(self, "_step_n", None) is not None
-            else self.num_participants()
+            self._step_n if self._step_n is not None else self.num_participants()
         )
         enough_replicas = n_step >= self._min_replica_size
         # a step whose collectives spanned two plane epochs (death-watch
