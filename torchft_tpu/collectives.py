@@ -354,6 +354,7 @@ class CollectivesTcp(Collectives):
         self._acceptor: Optional[threading.Thread] = None
         self._store = None
         self._executor: Optional[ThreadPoolExecutor] = None
+        self._ring_send_worker: Optional[ThreadPoolExecutor] = None
         self._p2p: Optional[ThreadPoolExecutor] = None
         self._op_seq = 0
 
@@ -369,13 +370,16 @@ class CollectivesTcp(Collectives):
         self._op_seq = 0
         with self._peers_lock:
             gen = self._generation
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tft_coll"
+        )
+        self._ring_send_worker = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tft_ring_send"
+        )
+        self._p2p = ThreadPoolExecutor(
+            max_workers=self._p2p_workers, thread_name_prefix="tft_p2p"
+        )
         if world_size == 1:
-            self._executor = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="tft_coll"
-            )
-            self._p2p = ThreadPoolExecutor(
-                max_workers=self._p2p_workers, thread_name_prefix="tft_p2p"
-            )
             return
 
         self._store = create_store_client(store_addr, connect_timeout=self._timeout)
@@ -391,12 +395,6 @@ class CollectivesTcp(Collectives):
             target=self._accept_loop, args=(listener, gen), daemon=True
         )
         self._acceptor.start()
-        self._executor = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="tft_coll"
-        )
-        self._p2p = ThreadPoolExecutor(
-            max_workers=self._p2p_workers, thread_name_prefix="tft_p2p"
-        )
         # Eagerly establish the full mesh so configure() surfaces
         # connectivity failures (and later ops can't stall on dial).
         deadline = self._timeout
@@ -682,6 +680,9 @@ class CollectivesTcp(Collectives):
         if self._executor is not None:
             self._executor.shutdown(wait=True, cancel_futures=True)
             self._executor = None
+        if self._ring_send_worker is not None:
+            self._ring_send_worker.shutdown(wait=True, cancel_futures=True)
+            self._ring_send_worker = None
         if self._p2p is not None:
             self._p2p.shutdown(wait=True, cancel_futures=True)
             self._p2p = None
@@ -953,23 +954,42 @@ class CollectivesTcp(Collectives):
         into: Optional[memoryview] = None,
     ) -> Optional[bytearray]:
         """Simultaneously send to dst and receive from src (ring step) —
-        the send runs on a helper thread so large transfers can't deadlock
-        on full OS socket buffers. With ``into``, the frame lands directly
-        in the caller's scratch buffer (no per-hop allocation)."""
-        err: List[BaseException] = []
-
-        def do_send() -> None:
+        the send runs on a persistent helper worker so large transfers
+        can't deadlock on full OS socket buffers (round-3 review weak #4:
+        a fresh Thread per hop burned hundreds of creations per step on
+        the GIL; collective ops are serialized on the op thread, so ONE
+        worker suffices). With ``into``, the frame lands directly in the
+        caller's scratch buffer (no per-hop allocation)."""
+        send_fut = self._ring_send_worker.submit(self._send_to, dst, tag, send_data)
+        recv_exc: Optional[BaseException] = None
+        data = None
+        try:
+            data = self._recv_from(src, tag, into=into)
+        except BaseException as e:  # noqa: BLE001
+            recv_exc = e
+            # the epoch is doomed either way (a failed hop latches the
+            # step and forces a flush re-quorum): unwedge a send parked on
+            # a full buffer so the drain below doesn't stall recovery for
+            # the full socket timeout
             try:
-                self._send_to(dst, tag, send_data)
-            except BaseException as e:  # noqa: BLE001
-                err.append(e)
-
-        t = threading.Thread(target=do_send, daemon=True)
-        t.start()
-        data = self._recv_from(src, tag, into=into)
-        t.join()
-        if err:
-            raise err[0]
+                self._peer(dst).sock.shutdown(socket.SHUT_RDWR)
+            except Exception:  # noqa: BLE001
+                pass
+        send_exc: Optional[BaseException] = None
+        try:
+            send_fut.result()
+        except BaseException as e:  # noqa: BLE001
+            send_exc = e
+        if recv_exc is not None:
+            # prefer the ACCUSING error: a PeerGone names the dead peer
+            # for eviction, a bare timeout does not
+            if isinstance(send_exc, PeerGoneError) and not isinstance(
+                recv_exc, PeerGoneError
+            ):
+                raise send_exc from recv_exc
+            raise recv_exc
+        if send_exc is not None:
+            raise send_exc
         return data
 
     def _next_tag(self) -> int:
