@@ -743,6 +743,15 @@ int DataPlane::allreduce(void* data, int64_t nelems, DpDtype dtype, DpOp op,
     std::unique_lock<std::mutex> g(st.mu);
     st.cv.wait(g, [&] { return st.done || closed_.load(); });
     if (!st.done) {
+      // Shutdown raced the op. A worker may still be inside run_stripe
+      // writing into the CALLER's buffer; returning -1 now would let
+      // Python free/reuse that memory under the worker's pen (shutdown's
+      // join runs on a different thread and doesn't gate this return).
+      // has_job still set means the worker exited at the top of its loop
+      // WITHOUT taking the job — nobody will touch the buffer; otherwise
+      // the worker is mid-job and, with the sockets now closed, will
+      // promptly fail the next hop and set done.
+      st.cv.wait(g, [&] { return st.done || st.has_job; });
       if (rc == 0) {
         *err = "dataplane shut down";
         rc = -1;

@@ -727,3 +727,56 @@ class TestCmaP2P:
         assert outs[0] in ("PeerGoneError", "ConnectionError"), outs
         assert len(C._CMA_QUARANTINE) == before + 1
         assert C._CMA_QUARANTINE[-1].nbytes == n * 4
+
+    def test_pull_failure_latches_cma_off(self, store, monkeypatch):
+        """Round-4 advisor medium: the negotiation probes only the ring-left
+        neighbor, but a passing vote arms pulls between ARBITRARY pairs. If
+        a pull then fails at op time (pairwise-asymmetric process_vm_readv
+        permission), the process must latch CMA off so the NEXT epoch's
+        negotiation converges the whole group to TCP — not retry into the
+        same failure every epoch."""
+        monkeypatch.setenv("TORCHFT_CMA_P2P_MIN", str(64 * 1024))
+        import torchft_tpu._native as N
+        import torchft_tpu.collectives as C
+
+        monkeypatch.setattr(C, "_CMA_BROKEN", False)
+
+        def broken(pid, addr, view):
+            raise OSError(1, "Operation not permitted")
+
+        monkeypatch.setattr(N, "cma_read_into", broken)
+        n = 1 << 18
+
+        def fn(c, rank):
+            assert c.plane_info() == "cma"  # probe (cma_read) still passes
+            got_err = False
+            if rank == 0:
+                try:
+                    c.send(np.ones(n, np.float32), dst=1, tag=9).wait(
+                        timedelta(seconds=15)
+                    )
+                except Exception:  # noqa: BLE001
+                    got_err = True
+            else:
+                buf = np.zeros(n, np.float32)
+                try:
+                    c.recv(buf, src=0, tag=9).wait(timedelta(seconds=15))
+                except Exception:  # noqa: BLE001
+                    got_err = True
+            # next epoch: the latch must force the WHOLE group to TCP,
+            # and ops must work there with process_vm_readv still broken
+            c.configure(f"{store.address()}/cmalatch2", rank, 2)
+            plane2 = c.plane_info()
+            out = c.allreduce(
+                [np.full(4, float(rank + 1), np.float32)], ReduceOp.SUM
+            ).wait(timedelta(seconds=15))
+            return got_err, plane2, float(out[0][0])
+
+        outs = _run_world(
+            store, 2, fn, prefix="cmalatch", timeout=timedelta(seconds=5)
+        )
+        assert C._CMA_BROKEN is True
+        # the receiver's pull failed; the sender's ack never arrived
+        assert outs[0][0] and outs[1][0], outs
+        assert outs[0][1] == "tcp-striped" and outs[1][1] == "tcp-striped"
+        assert outs[0][2] == 3.0 and outs[1][2] == 3.0

@@ -258,6 +258,52 @@ def test_allreduce_error_latches(harness):
     assert not m.should_commit()
 
 
+def test_mixed_epoch_span_on_one_rank_vetoes_group_wide(harness):
+    """Round-4 advisor low (manager.py:730): the epoch span is a LOCAL
+    observation — a death-watch re-quorum can land between ops on one rank
+    and entirely outside another's step. The lone observer votes False and
+    client.should_commit's global conjunction aborts everyone."""
+    h = harness()
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(max_rank=1)
+    m.start_quorum()
+    t = np.ones(2, dtype=np.float32)
+    m.allreduce(t).wait()
+    # a death-watch re-quorum lands mid-step on THIS rank only
+    m._quorum_id = 124
+    m.allreduce(t).wait()
+    assert len(m._step_epochs) == 2
+    h.client.should_commit.return_value = False  # global AND result
+    assert not m.should_commit()
+    # this rank's local vote was the veto that fed the conjunction
+    assert h.client.should_commit.call_args.args[2] is False
+
+    # the OTHER side of the same step: a rank that saw a single epoch
+    # votes True locally but is aborted by the conjunction anyway
+    m.start_quorum()
+    m.allreduce(t).wait()
+    assert len(m._step_epochs) == 1
+    h.client.should_commit.return_value = False
+    assert not m.should_commit()
+    assert h.client.should_commit.call_args.args[2] is True
+
+
+def test_stale_death_watch_callback_dropped(harness):
+    """Round-4 advisor low (manager.py:574): a POLLHUP delivered for an
+    OLD plane generation must not map its ring rank through the CURRENT
+    participant list (it could accuse a live replica)."""
+    h = harness()
+    m = h.manager
+    m._death_watch_snapshot = (5, ["rep_a", "rep_b"])
+    m._participant_ids = ["rep_x", "rep_y"]  # membership already replaced
+
+    m._on_peer_death(1, plane_gen=4)  # stale generation: dropped
+    assert m._evicted == set()
+
+    m._on_peer_death(1, plane_gen=5)  # current: maps through the SNAPSHOT
+    assert m._evicted == {"rep_b"}
+
+
 def test_not_enough_participants(harness):
     h = harness()
     m = h.manager

@@ -212,12 +212,41 @@ def _cma_p2p_min() -> int:
 # pathological loop is operator-visible.
 _CMA_QUARANTINE: List[np.ndarray] = []
 
+# PROCESS-LOCAL latch: the negotiation probe only proves a read of the
+# LEFT ring neighbor, but a passing vote arms direct pulls between
+# ARBITRARY rank pairs (p2p sends >= TORCHFT_CMA_P2P_MIN, descriptor
+# pulls in _recv_matched). If process_vm_readv permission is pairwise-
+# asymmetric (differing uids, YAMA ptrace_scope) the probe ring can pass
+# while a non-adjacent pull fails at op time — and since the negotiation
+# would re-succeed identically every epoch, the group would retry into
+# the same failure forever. A failed pull latches this flag; the next
+# epoch's negotiation publishes ok=0 so the whole group settles on TCP.
+_CMA_BROKEN = False
+
 
 def _cma_pull(pid: int, addr: int, view: memoryview) -> None:
     """process_vm_readv the peer's [addr, addr+len) into ``view``."""
+    global _CMA_BROKEN
+    import errno
+
     from torchft_tpu._native import cma_read_into
 
-    cma_read_into(pid, addr, view)
+    try:
+        cma_read_into(pid, addr, view)
+    except OSError as e:
+        # Permission-class failures only: the probe ring proved a read of
+        # the LEFT neighbor, so EPERM/EACCES on another pair means the
+        # permission matrix is pairwise-asymmetric and every epoch's
+        # negotiation would re-arm the same broken path. ESRCH/EFAULT
+        # from a peer that just DIED is the normal FT case — re-quorum
+        # recovers it and CMA must stay available for the next cohort.
+        if e.errno in (errno.EPERM, errno.EACCES):
+            _CMA_BROKEN = True
+            logger.warning(
+                "CMA pull from pid %d denied (%s); latching CMA off — "
+                "next reconfigure converges the group to TCP", pid, e,
+            )
+        raise
 
 
 def _send_frame(sock: socket.socket, tag: int, payload: memoryview) -> None:
@@ -331,7 +360,7 @@ class CollectivesTcp(Collectives):
         self._dp = None  # NativeDataPlane for the current epoch
         self._dp_cma_pids: Optional[List[int]] = None  # p2p CMA fast path
         self._cma_p2p_min = _cma_p2p_min()  # resolved once, not per frame
-        self._death_watch_cb: Optional[Callable[[int], None]] = None
+        self._death_watch_cb: Optional[Callable[[int, int], None]] = None
         self._timeout = timeout
         self._hostname = hostname or socket.gethostname()
         if wire_dtype:
@@ -415,9 +444,13 @@ class CollectivesTcp(Collectives):
                 name="tft_death_watch",
             ).start()
 
-    def set_death_watch(self, cb: Callable[[int], None]) -> None:
-        """Register a peer-death callback (called with the ring rank whose
-        socket hit EOF/error). Armed at the NEXT configure(). This is the
+    def set_death_watch(self, cb: Callable[..., None]) -> None:
+        """Register a peer-death callback, called ``cb(ring_rank, gen)``
+        with the ring rank whose socket hit EOF/error and the plane
+        generation whose ring that rank belongs to (pair it with
+        :meth:`plane_generation` to drop callbacks that raced a
+        reconfigure — the same ring rank means a different replica in a
+        different epoch). Armed at the NEXT configure(). This is the
         active failure detector: a SIGKILLed peer's FIN reaches every
         survivor within milliseconds, long before their next collective op
         touches the socket — the callback lets the Manager evict and
@@ -425,6 +458,12 @@ class CollectivesTcp(Collectives):
         boundary. False positives (a peer tearing down an old epoch early)
         are safe: eviction is liveness-probe-guarded at the lighthouse."""
         self._death_watch_cb = cb
+
+    def plane_generation(self) -> int:
+        """Monotonic epoch counter, bumped by every configure()/teardown.
+        Death-watch callbacks carry the generation they were armed for."""
+        with self._peers_lock:
+            return self._generation
 
     def _death_watch_loop(self, gen: int) -> None:
         import select
@@ -473,7 +512,7 @@ class CollectivesTcp(Collectives):
                 cb = self._death_watch_cb
                 if cb is not None:
                     try:
-                        cb(rank)
+                        cb(rank, gen)
                     except Exception:  # noqa: BLE001
                         logger.exception("death-watch callback failed")
 
@@ -533,7 +572,14 @@ class CollectivesTcp(Collectives):
         # that did not opt out would otherwise block their whole rendezvous
         # deadline on keys that never appear, failing configure on every
         # epoch instead of settling on TCP in one round.
-        opt_out = os.environ.get("TORCHFT_DP_CMA", "1") == "0"
+        # the broken-latch counts as an opt-out: this rank votes ok=0 so
+        # the group-wide all-ok conjunction converges everyone to TCP
+        opt_out = os.environ.get("TORCHFT_DP_CMA", "1") == "0" or _CMA_BROKEN
+        if _CMA_BROKEN:
+            logger.info(
+                "CMA disabled this epoch: a prior pull failed in this "
+                "process (pairwise-asymmetric process_vm_readv permission)"
+            )
         from torchft_tpu._native import cma_read
 
         token = secrets.token_bytes(16)

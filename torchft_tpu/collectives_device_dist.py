@@ -26,6 +26,21 @@ launcher.py), or the caller falls back to the host plane, which is what
 the elastic path is for. Plane selection table: README "Choosing a
 cross-group data plane".
 
+Op surface (round-4 review missing #2 closed): the symmetric
+collectives — allreduce, allgather, broadcast, reduce_scatter,
+alltoall, barrier — ride the device mesh (psum / all_gather /
+psum_scatter / all_to_all over the global ``'ft'`` axis). Point-to-point
+``send``/``recv`` cannot ride a multi-controller runtime (a compiled
+collective needs every process in the same program; p2p involves two),
+so they ride a host TCP side-channel — an embedded
+:class:`~torchft_tpu.collectives.CollectivesTcp` configured on the same
+epoch store — which is also what makes
+:class:`~torchft_tpu.checkpointing.collectives_transport.CollectivesTransport`
+(live heals) work on this plane. This mirrors how NCCL separates
+collective rings from p2p channels. Non-uniform input lists for
+reduce_scatter/alltoall (per-slot shapes/dtypes) take the side-channel
+too; the device path requires a stackable list.
+
 Runtime bootstrap: call ``jax.distributed.initialize`` before first jax
 use (the launcher's ``--jax-coordinator`` wiring or
 ``init_distributed`` below), one process per replica group.
@@ -88,6 +103,9 @@ class CollectivesDeviceDist(Collectives):
         self._world = 0
         self._mesh = None
         self._jit_cache: Dict[Tuple, Callable] = {}
+        # host TCP side-channel for p2p (and ragged reduce_scatter/
+        # alltoall): created at first configure, reconfigured per epoch
+        self._p2p: Optional[Any] = None
 
     # -- lifecycle --
 
@@ -120,8 +138,29 @@ class CollectivesDeviceDist(Collectives):
         self._rank = rank
         self._world = world_size
         self._jit_cache.clear()
+        # p2p side-channel: every cohort member reaches configure (the
+        # Manager reconfigures all members on a quorum change), so the
+        # full-mesh TCP dial inside is a safe per-epoch barrier. Plain
+        # sockets only (native_plane=False): bulk traffic rides ICI; this
+        # channel exists for heals and ragged ops. A store is required
+        # for its rendezvous — standalone use with store_addr="" keeps
+        # the symmetric device collectives and loses only p2p.
+        if store_addr:
+            from torchft_tpu.collectives import CollectivesTcp
+
+            if self._p2p is None:
+                self._p2p = CollectivesTcp(
+                    timeout=self._timeout, native_plane=False
+                )
+            self._p2p.configure(store_addr, rank, world_size)
+        elif self._p2p is not None:
+            self._p2p.shutdown()
+            self._p2p = None
 
     def shutdown(self) -> None:
+        if self._p2p is not None:
+            self._p2p.shutdown()
+            self._p2p = None
         self._mesh = None
 
     def size(self) -> int:
@@ -132,15 +171,44 @@ class CollectivesDeviceDist(Collectives):
 
     # -- plumbing --
 
-    def _reduce_jit(self, shape, dtype, op: ReduceOp) -> Callable:
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        key = (tuple(shape), str(dtype), op)
+    def _cached_jit(self, key: Tuple, body, replicated_out: bool = False,
+                    **shard_map_kwargs) -> Callable:
+        """Build-or-fetch the jitted shard_map for ``body`` over 'ft'."""
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
-        mesh = self._mesh
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        out_spec = P() if replicated_out else P("ft")
+        fn = jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=P("ft"),
+                out_specs=out_spec,
+                **shard_map_kwargs,
+            ),
+            out_shardings=NamedSharding(self._mesh, out_spec),
+        )
+        self._jit_cache[key] = fn
+        return fn
+
+    def _stage(self, host_block: np.ndarray):
+        """Place this process's ``[1, ...]`` host block as its shard of
+        the 'ft'-sharded global array."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self._mesh, P("ft")),
+            host_block,
+            (self._world, *host_block.shape[1:]),
+        )
+
+    def _reduce_jit(self, shape, dtype, op: ReduceOp) -> Callable:
+        import jax
+
         world = self._world
 
         def block(x):  # x: local [1, *shape] block
@@ -154,53 +222,38 @@ class CollectivesDeviceDist(Collectives):
                 r = jax.lax.pmin(x, "ft")
             return r
 
-        reduced = jax.jit(
-            jax.shard_map(
-                block,
-                mesh=mesh,
-                in_specs=P("ft"),
-                out_specs=P("ft"),
-            ),
-            out_shardings=NamedSharding(mesh, P("ft")),
-        )
-        self._jit_cache[key] = reduced
-        return reduced
+        return self._cached_jit((tuple(shape), str(dtype), op), block)
 
     def _gather_jit(self, shape, dtype) -> Callable:
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        key = (tuple(shape), str(dtype), "allgather")
-        fn = self._jit_cache.get(key)
-        if fn is not None:
-            return fn
-        fn = jax.jit(
-            jax.shard_map(
-                lambda x: jax.lax.all_gather(x, "ft", axis=0, tiled=True),
-                mesh=self._mesh,
-                in_specs=P("ft"),
-                out_specs=P(),
-                # all_gather(tiled) IS replicated over 'ft'; the VMA
-                # checker just can't infer it through the tiled form
-                check_vma=False,
-            ),
-            out_shardings=NamedSharding(self._mesh, P()),
+        return self._cached_jit(
+            (tuple(shape), str(dtype), "allgather"),
+            lambda x: jax.lax.all_gather(x, "ft", axis=0, tiled=True),
+            replicated_out=True,
+            # all_gather(tiled) IS replicated over 'ft'; the VMA
+            # checker just can't infer it through the tiled form
+            check_vma=False,
         )
-        self._jit_cache[key] = fn
-        return fn
+
+    @staticmethod
+    def _check_avg_dtype(op: ReduceOp, dtype: np.dtype) -> None:
+        """AVG on integer inputs would silently truncate on the host-copy
+        assignment here, while the host TCP plane's in-place np.divide
+        raises a casting error — keep the planes' failure semantics
+        identical (round-4 advisor low)."""
+        if op == ReduceOp.AVG and not np.issubdtype(dtype, np.inexact):
+            raise TypeError(
+                f"ReduceOp.AVG on dtype {np.dtype(dtype)} would truncate; "
+                "cast to a float dtype first (matches the host plane's "
+                "np.divide casting error)"
+            )
 
     def _allreduce_one(self, arr: np.ndarray, op: ReduceOp) -> None:
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        sharding = NamedSharding(self._mesh, P("ft"))
-        host = np.ascontiguousarray(arr)[None, ...]
-        garr = jax.make_array_from_process_local_data(
-            sharding, host, (self._world, *arr.shape)
-        )
+        self._check_avg_dtype(op, arr.dtype)
+        garr = self._stage(np.ascontiguousarray(arr)[None, ...])
         out = self._reduce_jit(arr.shape, arr.dtype, op)(garr)
-        shard = out.addressable_shards[0].data
-        arr[...] = np.asarray(shard)[0]
+        arr[...] = np.asarray(out.addressable_shards[0].data)[0]
 
     # -- collectives --
 
@@ -218,17 +271,10 @@ class CollectivesDeviceDist(Collectives):
             return Work(fut)
 
     def allgather(self, arr: np.ndarray) -> Work:
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         try:
             if self._world == 1:
                 return Work.completed([arr.copy()])
-            sharding = NamedSharding(self._mesh, P("ft"))
-            garr = jax.make_array_from_process_local_data(
-                sharding, np.ascontiguousarray(arr)[None, ...],
-                (self._world, *arr.shape),
-            )
+            garr = self._stage(np.ascontiguousarray(arr)[None, ...])
             gathered = self._gather_jit(arr.shape, arr.dtype)(garr)
             local = np.asarray(gathered.addressable_shards[0].data)
             return Work.completed([local[i] for i in range(self._world)])
@@ -246,30 +292,109 @@ class CollectivesDeviceDist(Collectives):
 
         return Work(out.get_future().then(pick))
 
+    @staticmethod
+    def _uniform(arrays: List[np.ndarray]) -> bool:
+        first = arrays[0]
+        return all(
+            a.shape == first.shape and a.dtype == first.dtype
+            for a in arrays[1:]
+        )
+
+    def _rs_jit(self, shape, dtype) -> Callable:
+        import jax
+
+        # global [world, world, *shape], dim 0 sharded on 'ft' (the
+        # contributing rank), dim 1 the destination slot; psum_scatter
+        # over slots leaves rank r holding sum_contributors(slot r)
+        return self._cached_jit(
+            (tuple(shape), str(dtype), "reduce_scatter"),
+            lambda x: jax.lax.psum_scatter(
+                x, "ft", scatter_dimension=1, tiled=False
+            ),
+        )
+
+    def _a2a_jit(self, shape, dtype) -> Callable:
+        import jax
+
+        # local block [1, world, *shape]: split the slot dim across 'ft',
+        # concatenate along the (sharded) leading dim — rank r ends with
+        # [world, 1, *shape] where entry j is rank j's slot r
+        return self._cached_jit(
+            (tuple(shape), str(dtype), "alltoall"),
+            lambda x: jax.lax.all_to_all(
+                x, "ft", split_axis=1, concat_axis=0, tiled=True
+            ),
+        )
+
     def reduce_scatter(
         self, arrays: List[np.ndarray], op: ReduceOp = ReduceOp.SUM
     ) -> Work:
-        raise NotImplementedError(
-            "reduce_scatter is not offered on the shared-runtime plane; "
-            "use CollectivesTcp (host) for non-allreduce collectives"
-        )
+        try:
+            if len(arrays) != self._world:
+                raise ValueError(
+                    f"reduce_scatter needs {self._world} inputs, "
+                    f"got {len(arrays)}"
+                )
+            # dtype check BEFORE the world==1 return: the host plane's
+            # np.divide raises for AVG-on-int even at world 1
+            self._check_avg_dtype(op, arrays[0].dtype)
+            if self._world == 1:
+                return Work.completed(arrays[0].copy())
+            if not self._uniform(arrays):
+                # ragged slots can't stack into one device array
+                return self._p2p_or_raise().reduce_scatter(arrays, op)
+            if op not in (ReduceOp.SUM, ReduceOp.AVG):
+                # psum_scatter is sum-only; max/min scatter is a host op
+                return self._p2p_or_raise().reduce_scatter(arrays, op)
+            shape, dtype = arrays[0].shape, arrays[0].dtype
+            garr = self._stage(np.ascontiguousarray(np.stack(arrays))[None])
+            out_g = self._rs_jit(shape, dtype)(garr)
+            out = np.asarray(out_g.addressable_shards[0].data)[0]
+            if op == ReduceOp.AVG:
+                out = out / self._world
+            return Work.completed(out.astype(dtype, copy=False))
+        except Exception as e:  # noqa: BLE001 — surface through the future
+            fut: Future = Future()
+            fut.set_exception(e)
+            return Work(fut)
 
     def alltoall(self, arrays: List[np.ndarray]) -> Work:
-        raise NotImplementedError(
-            "alltoall is not offered on the shared-runtime plane"
-        )
+        try:
+            if len(arrays) != self._world:
+                raise ValueError(
+                    f"alltoall needs {self._world} inputs, got {len(arrays)}"
+                )
+            if self._world == 1:
+                return Work.completed([arrays[0].copy()])
+            if not self._uniform(arrays):
+                return self._p2p_or_raise().alltoall(arrays)
+            shape, dtype = arrays[0].shape, arrays[0].dtype
+            garr = self._stage(np.ascontiguousarray(np.stack(arrays))[None])
+            out_g = self._a2a_jit(shape, dtype)(garr)
+            local = np.asarray(out_g.addressable_shards[0].data)
+            # local: [world, 1, *shape] — entry j is rank j's slot for us
+            return Work.completed(
+                [local[j, 0].copy() for j in range(self._world)]
+            )
+        except Exception as e:  # noqa: BLE001
+            fut: Future = Future()
+            fut.set_exception(e)
+            return Work(fut)
+
+    def _p2p_or_raise(self):
+        if self._p2p is None:
+            raise RuntimeError(
+                "the p2p side-channel needs a store rendezvous: "
+                "configure() with a non-empty store_addr (the Manager "
+                "always does)"
+            )
+        return self._p2p
 
     def send(self, arr: np.ndarray, dst: int, tag: int = 0) -> Work:
-        raise NotImplementedError(
-            "p2p is not offered on the shared-runtime plane; checkpoint "
-            "heals ride the HTTP transport"
-        )
+        return self._p2p_or_raise().send(arr, dst, tag)
 
     def recv(self, arr: np.ndarray, src: int, tag: int = 0) -> Work:
-        raise NotImplementedError(
-            "p2p is not offered on the shared-runtime plane; checkpoint "
-            "heals ride the HTTP transport"
-        )
+        return self._p2p_or_raise().recv(arr, src, tag)
 
     def barrier(self) -> Work:
         one = np.ones(1, dtype=np.float32)

@@ -30,7 +30,7 @@ import uuid
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
 from enum import Enum
-from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
+from typing import Any, Callable, Dict, List, Optional, Tuple, TypeVar, cast
 
 import numpy as np
 
@@ -220,6 +220,8 @@ class Manager:
         self._quorum_id = -1
         self._participant_ids: List[str] = []  # replica_rank -> replica_id
         self._evicted: set = set()  # victims already reported this epoch
+        # (plane_generation, participant_ids) armed for the death watch
+        self._death_watch_snapshot: Optional[Tuple[int, List[str]]] = None
         self._commit_failures = 0  # pending data-plane flush request
         self._errored: Optional[Exception] = None
         self._errored_epoch = -1  # quorum_id whose plane produced _errored
@@ -373,6 +375,15 @@ class Manager:
             self._collectives.configure(
                 store_prefixed_addr, quorum.replica_rank, quorum.replica_world_size
             )
+            if hasattr(self._collectives, "plane_generation"):
+                # (gen, ids) snapshot for death-watch callbacks: published
+                # AFTER configure, so a callback from the new ring that
+                # races this store is dropped as stale — safe, the lease
+                # still expires passively
+                self._death_watch_snapshot = (
+                    self._collectives.plane_generation(),
+                    list(quorum.participant_ids),
+                )
             self._quorum_id = quorum.quorum_id
             # fresh epoch: the flush request (if any) has been honored
             self._commit_failures = 0
@@ -571,7 +582,7 @@ class Manager:
         self._errored_epoch = self._quorum_id
         self._maybe_evict(e)
 
-    def _on_peer_death(self, ring_rank: int) -> None:
+    def _on_peer_death(self, ring_rank: int, plane_gen: Optional[int] = None) -> None:
         """Death-watch callback (runs on the collectives monitor thread):
         a peer's socket hit EOF/error mid-epoch. Report the eviction NOW
         (liveness-probe-guarded at the lighthouse, so a false positive is
@@ -579,14 +590,36 @@ class Manager:
         time the trainer finishes the doomed step, the shrunken quorum is
         usually already delivered and the plane reconfigured, so the
         survivor pays ~one step instead of detection+quorum+reconfigure
-        serialized after it."""
+        serialized after it.
+
+        ``plane_gen`` tags the ring the rank belongs to: a late POLLHUP
+        delivered while ``_async_quorum`` replaces membership would
+        otherwise map an OLD ring rank through the NEW participant list
+        and accuse a live replica (burning a lighthouse liveness probe
+        and delaying the real re-quorum)."""
         from torchft_tpu.collectives import PeerGoneError
 
         if self._shutting_down:
             return
-        self._maybe_evict(
-            PeerGoneError(ring_rank, f"death watch: peer {ring_rank} socket closed")
+        snap = self._death_watch_snapshot
+        if plane_gen is not None and snap is not None:
+            snap_gen, snap_ids = snap
+            if plane_gen != snap_gen:
+                self._logger.info(
+                    f"dropping stale death-watch callback for ring rank "
+                    f"{ring_rank} (plane gen {plane_gen} != armed {snap_gen})"
+                )
+                return
+        else:
+            snap_ids = None
+        err = PeerGoneError(
+            ring_rank, f"death watch: peer {ring_rank} socket closed"
         )
+        if snap_ids is not None:
+            # map the ring rank through the SNAPSHOT for this generation,
+            # never through whatever _participant_ids holds right now
+            err._tft_participants = list(snap_ids)
+        self._maybe_evict(err)
         with self._qf_lock:
             if self._shutting_down:
                 return
@@ -725,8 +758,10 @@ class Manager:
         )
         enough_replicas = n_step >= self._min_replica_size
         # a step whose collectives spanned two plane epochs (death-watch
-        # re-quorum mid-step) mixed normalization denominators — every
-        # rank sees the same span, so the veto is group-consistent
+        # re-quorum mid-step) mixed normalization denominators. The span is
+        # a LOCAL observation — the re-quorum can land between ops on one
+        # rank and entirely after another's — but client.should_commit is a
+        # global conjunction, so one rank's veto aborts the step group-wide
         mixed_epochs = len(self._step_epochs) > 1
         local_should_commit = (
             enough_replicas and self._errored is None and not mixed_epochs
