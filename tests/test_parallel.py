@@ -71,6 +71,39 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(g2), np.asarray(g1), atol=2e-5)
 
 
+class TestRematPolicy:
+    def test_dots_matches_all_and_typo_raises(self):
+        """remat_policy='dots' (save matmul outputs) must be numerically
+        identical to full-layer recompute, and unknown values must raise
+        instead of silently paying full recompute (round-5 review)."""
+        mesh = make_mesh(MeshConfig())
+        t = tokens()
+        losses, grads = [], []
+        for policy in ("all", "dots"):
+            cfg = TransformerConfig(**{**CFG, "remat_policy": policy})
+            params = init_params(jax.random.PRNGKey(0), cfg)
+            with jax.set_mesh(mesh):
+                l, g = jax.jit(
+                    jax.value_and_grad(lambda p, t: loss_fn(p, t, cfg, mesh))
+                )(params, t)
+            losses.append(float(l))
+            grads.append(g)
+        assert losses[0] == pytest.approx(losses[1], rel=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            grads[0],
+            grads[1],
+        )
+
+        bad = TransformerConfig(**{**CFG, "remat_policy": "dot"})
+        params = init_params(jax.random.PRNGKey(0), bad)
+        with pytest.raises(ValueError, match="remat_policy"):
+            with jax.set_mesh(mesh):
+                jax.jit(lambda p, t: loss_fn(p, t, bad, mesh))(params, t)
+
+
 class TestTransformer:
     def test_dense_loss_and_grads(self):
         cfg = TransformerConfig(**CFG)

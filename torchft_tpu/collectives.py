@@ -103,6 +103,12 @@ class Work:
     def completed(value=None) -> "Work":
         return Work(Future.completed(value))
 
+    @staticmethod
+    def failed(exc: BaseException) -> "Work":
+        fut: Future = Future()
+        fut.set_exception(exc)
+        return Work(fut)
+
 
 class Collectives(ABC):
     """Abstract reconfigurable collectives over a replica axis."""

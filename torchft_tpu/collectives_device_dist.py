@@ -266,9 +266,7 @@ class CollectivesDeviceDist(Collectives):
                 pass  # world 1: average of one is identity
             return Work.completed(arrays)
         except Exception as e:  # noqa: BLE001 — surface through the future
-            fut: Future = Future()
-            fut.set_exception(e)
-            return Work(fut)
+            return Work.failed(e)
 
     def allgather(self, arr: np.ndarray) -> Work:
         try:
@@ -277,11 +275,11 @@ class CollectivesDeviceDist(Collectives):
             garr = self._stage(np.ascontiguousarray(arr)[None, ...])
             gathered = self._gather_jit(arr.shape, arr.dtype)(garr)
             local = np.asarray(gathered.addressable_shards[0].data)
-            return Work.completed([local[i] for i in range(self._world)])
-        except Exception as e:  # noqa: BLE001
-            fut: Future = Future()
-            fut.set_exception(e)
-            return Work(fut)
+            return Work.completed(
+                [local[i].copy() for i in range(self._world)]
+            )
+        except Exception as e:  # noqa: BLE001 — surface through the future
+            return Work.failed(e)
 
     def broadcast(self, arr: np.ndarray, root: int = 0) -> Work:
         out = self.allgather(arr)
@@ -349,14 +347,15 @@ class CollectivesDeviceDist(Collectives):
             shape, dtype = arrays[0].shape, arrays[0].dtype
             garr = self._stage(np.ascontiguousarray(np.stack(arrays))[None])
             out_g = self._rs_jit(shape, dtype)(garr)
-            out = np.asarray(out_g.addressable_shards[0].data)[0]
+            # np.asarray of a jax shard is a READ-ONLY view; the host
+            # plane returns writable arrays, so copy (alltoall below
+            # and allgather do the same)
+            out = np.array(np.asarray(out_g.addressable_shards[0].data)[0])
             if op == ReduceOp.AVG:
                 out = out / self._world
             return Work.completed(out.astype(dtype, copy=False))
         except Exception as e:  # noqa: BLE001 — surface through the future
-            fut: Future = Future()
-            fut.set_exception(e)
-            return Work(fut)
+            return Work.failed(e)
 
     def alltoall(self, arrays: List[np.ndarray]) -> Work:
         try:
@@ -376,10 +375,8 @@ class CollectivesDeviceDist(Collectives):
             return Work.completed(
                 [local[j, 0].copy() for j in range(self._world)]
             )
-        except Exception as e:  # noqa: BLE001
-            fut: Future = Future()
-            fut.set_exception(e)
-            return Work(fut)
+        except Exception as e:  # noqa: BLE001 — surface through the future
+            return Work.failed(e)
 
     def _p2p_or_raise(self):
         if self._p2p is None:

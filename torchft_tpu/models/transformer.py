@@ -56,6 +56,11 @@ class TransformerConfig:
     norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16  # compute dtype (MXU-native)
     remat: bool = True
+    # checkpoint policy under remat: "all" recomputes the whole layer in
+    # the backward (lowest memory); "dots" saves matmul outputs and
+    # recomputes only elementwise/softmax (MXU work runs once — the
+    # round-5 sweet spot at short S where memory isn't the constraint)
+    remat_policy: str = "all"
     pp: int = 1  # pipeline stages; n_layers % pp == 0
     microbatches: int = 0  # 0 => = pp
     # "auto" | "plain" | "chunked" | "flash". auto: plain XLA attention at
@@ -255,24 +260,43 @@ def _use_flash(
 
 def _attn_chunk() -> int:
     """Per-call (env-overridable, like every other knob in this file).
-    C=256 measured best on v5e at s in [4k, 16k] (bench sweep r04)."""
+    Round-5 v5e sweep (full-model grads, d512 L8): C=128 beats 256 by
+    ~7% at s=8k and ~15% at s=32k (1046 vs 1241 ms with 16 tiers) and is
+    within noise everywhere else in [1k, 16k] — smaller q-blocks keep the
+    per-block f32 scores fusion-local deeper into the causal prefix."""
     import os
 
     try:
-        return int(os.environ.get("TORCHFT_TPU_ATTN_CHUNK", "256"))
+        return int(os.environ.get("TORCHFT_TPU_ATTN_CHUNK", "128"))
     except ValueError:
-        return 256
+        return 128
+
+
+def _attn_tiers() -> Optional[int]:
+    """Causal k-prefix tier count override (TORCHFT_TPU_ATTN_TIERS);
+    unset/invalid -> None, i.e. chunked_attention's adaptive pick."""
+    import os
+
+    raw = os.environ.get("TORCHFT_TPU_ATTN_TIERS")
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
 
 
 def _use_chunked(cfg: TransformerConfig, seq_len: int) -> bool:
     """Route to :func:`chunked_attention` (round-3 review missing #4: the
     4k–16k band sat at 15% MFU on XLA plain attention with no mitigation).
-    The scan amortizes past ~4k, where plain attention's f32 [S,S] scores
-    start round-tripping HBM; below that plain is equal or better and
-    compiles simpler. Pure XLA — works under GSPMD sharding AND inside
-    the pipeline's manual region, unlike the pallas kernel. Override the
-    engage point with TORCHFT_TPU_ATTN_CHUNKED_MIN_S. Sequences not
-    divisible by the chunk fall back to plain (both explicit and auto)."""
+    Round-5 sweep moved the engage point down to 1024: even there plain
+    attention's f32 [S,S] scores round-trip HBM (full-model grads at the
+    d512/L8/b8/s1024 headline: 52 ms plain vs 41–44 ms chunked; s=2048:
+    133 vs 92; s=512 is a wash, so plain keeps its simpler compile below
+    1k). Pure XLA — works under GSPMD sharding AND inside the pipeline's
+    manual region, unlike the pallas kernel. Override the engage point
+    with TORCHFT_TPU_ATTN_CHUNKED_MIN_S. Sequences not divisible by the
+    chunk fall back to plain (both explicit and auto)."""
     if seq_len % _attn_chunk() != 0:
         return False
     if cfg.attention_impl == "chunked":
@@ -282,9 +306,9 @@ def _use_chunked(cfg: TransformerConfig, seq_len: int) -> bool:
     import os
 
     try:
-        min_s = int(os.environ.get("TORCHFT_TPU_ATTN_CHUNKED_MIN_S", "4096"))
+        min_s = int(os.environ.get("TORCHFT_TPU_ATTN_CHUNKED_MIN_S", "1024"))
     except ValueError:
-        min_s = 4096
+        min_s = 1024
     return seq_len >= min_s
 
 
@@ -335,7 +359,9 @@ def _make_layer_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
         elif sp_size > 1:
             att = ring_attention(q, k, v, mesh, causal=True)
         elif _use_chunked(cfg, s):
-            att = chunked_attention(q, k, v, causal=True, chunk=_attn_chunk())
+            att = chunked_attention(
+                q, k, v, causal=True, chunk=_attn_chunk(), tiers=_attn_tiers()
+            )
         elif _use_flash(cfg, s, b, mesh):
             # flash needs its own (full) manual region, which can't nest
             # inside the pipeline's partial-manual shard_map (Shardy rejects
@@ -371,7 +397,16 @@ def _make_layer_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
 def _make_stage_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
     layer_fn = _make_layer_fn(cfg, mesh, sp_manual)
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "all":
+            policy = None
+        else:
+            raise ValueError(
+                f"remat_policy={cfg.remat_policy!r}: expected 'all' or "
+                "'dots' (a typo here would silently pay full recompute)"
+            )
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     def stage_fn(stage_params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
         # stage_params leaves: [Lp, ...]; scan over the layer axis

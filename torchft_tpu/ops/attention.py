@@ -89,7 +89,10 @@ def chunked_attention(
     b, s, h, d = q.shape
     assert s % chunk == 0, f"seq {s} not divisible by chunk {chunk}"
     if tiers is None:
-        tiers = max(4, min(16, s // 2048))
+        # round-5 v5e sweep (full-model grads, C=128): s<=8k prefers 4
+        # tiers (9.92 vs 9.44 steps/s at 8k going 4->8), s>=16k prefers
+        # 16 (16k: 3.34 vs 3.29 at 8; 32k: 1046 ms at 16 vs 1089 at 8)
+        tiers = 16 if s >= 16384 else 4
         # the divisibility gate below would otherwise silently drop
         # tiering for s values the pick doesn't divide — fall to the
         # largest compatible tier count instead
