@@ -152,11 +152,15 @@ def _measure(averaging: bool, steps: int, warmup: int) -> float:
 def main() -> None:
     _ensure_cpu_mesh()
     steps, warmup = 5, 2
-    # interleave the variants and keep best-of-2 per variant: on a shared
-    # CPU host the run-to-run noise otherwise dwarfs the psum cost (the
-    # first cut of this bench measured the overhead at -80%)
+    # Interleave the variants and keep best-of-4 per variant: on a
+    # 1-core host the run-to-run noise otherwise dwarfs the psum cost
+    # (the first cut measured the overhead at -80%, and round 4's
+    # best-of-2 still drifted 17% between rounds — review weak #7). The
+    # MAX is the right statistic here: contention only ever subtracts,
+    # so the fastest run is the closest view of the machine-independent
+    # cost, and 4 samples make it stable across rounds.
     avg_runs, noavg_runs = [], []
-    for _ in range(2):
+    for _ in range(4):
         avg_runs.append(_measure(True, steps, warmup))
         noavg_runs.append(_measure(False, steps, warmup))
     with_avg, without = max(avg_runs), max(noavg_runs)
@@ -167,15 +171,17 @@ def main() -> None:
                 "steps_per_sec_2group_avg": round(with_avg, 4),
                 "steps_per_sec_2group_noavg": round(without, 4),
                 "averaging_overhead_pct": round(overhead, 2),
+                "avg_runs": [round(r, 4) for r in avg_runs],
+                "noavg_runs": [round(r, 4) for r in noavg_runs],
                 "config": "2 groups × dp=4 virtual CPU devices, d256 L4 "
                 "b4 s128 f32, device-path 'ft' psum, sync quorum; "
-                "best-of-2 per variant",
+                "best-of-4 per variant, runs recorded",
                 "limitation": "CPU-mesh proxy metric: compute here is "
                 "unrealistically cheap relative to the psum, so the "
-                "overhead_pct OVERSTATES the on-chip cost (a real-TPU "
-                "2-process-per-chip session measured ~2% at r02 config); "
-                "a single-chip box cannot isolate the multi-chip "
-                "'ft'-psum cost at realistic model sizes",
+                "overhead_pct OVERSTATES the on-chip cost; a single-chip "
+                "box cannot isolate the multi-chip 'ft'-psum cost at "
+                "realistic model sizes (the real-chip complement is the "
+                "tpu_2group_hostplane row)",
             }
         ),
         flush=True,

@@ -1,0 +1,163 @@
+"""Async-vs-sync quorum at a config where the quorum RPC costs something.
+
+Round-4 review weak #2/#3: the old ``quorum_overlap`` extra compared
+async/sync at the single-group headline, where a localhost quorum RPC is
+sub-millisecond against a ~50 ms step — the measured 0.19% "gain" was
+noise, and citing it as evidence for ``use_async_quorum=True`` was
+wrong. This module measures the regime the flag EXISTS for: TWO replica
+groups over the host TCP plane with a synthetic round-trip injected into
+the quorum RPC (``--rtt-ms``, default 10 — a modest intra-region DCN
+hop; the lighthouse is the one deployment component expected off-host,
+reference README topology). Async overlaps that RPC with the forward
+pass; sync pays it serially every step.
+
+Protocol: interleaved A/B (async, sync, async, ...) with ``--runs``
+pairs (default 7), reporting per-variant median and spread — one hot
+pair would let host contamination on a single leg fabricate the result.
+
+Run: ``python -m torchft_tpu.benchmarks.quorum_overlap`` (CPU platform;
+prints one JSON line).
+"""
+
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from typing import List
+
+
+def _train_group(
+    replica_id: int,
+    lighthouse_addr: str,
+    use_async: bool,
+    rtt_s: float,
+    steps: int,
+    work_ms: float,
+) -> float:
+    """One replica group (thread): real Manager + TCP collectives, a
+    fixed-duration 'forward pass', and the per-step quorum+commit path.
+    Returns steps/s for the timed window."""
+    import numpy as np
+
+    from torchft_tpu.collectives import CollectivesTcp
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.store import StoreServer
+
+    store = StoreServer()
+    manager = Manager(
+        collectives=CollectivesTcp(timeout=timedelta(seconds=20)),
+        load_state_dict=lambda s: None,
+        state_dict=lambda: {},
+        min_replica_size=2,
+        replica_id=f"qo_{replica_id}",
+        store_addr=store.address(),
+        rank=0,
+        world_size=1,
+        lighthouse_addr=lighthouse_addr,
+        use_async_quorum=use_async,
+        timeout=timedelta(seconds=20),
+    )
+    # Synthetic RTT on the quorum RPC only (the long-poll the flag is
+    # meant to hide). Injected at the client wrapper so async and sync
+    # take the identical delayed path; commit votes ride the group's OWN
+    # manager server on localhost and stay fast, as in a real deployment
+    # where the lighthouse is the remote component.
+    real_quorum = manager._client._quorum
+
+    def slow_quorum(*args, **kwargs):
+        time.sleep(rtt_s)
+        return real_quorum(*args, **kwargs)
+
+    manager._client._quorum = slow_quorum
+
+    grad = np.ones(1 << 16, dtype=np.float32)
+    try:
+        def step() -> None:
+            manager.start_quorum()
+            # the "forward pass": sleep, not a busy-wait — two groups
+            # share this 1-core box, and a GIL-holding spin would stretch
+            # the nominal work_ms and starve the async-quorum executor,
+            # corrupting the very ratio being measured. sleep models
+            # off-host device compute faithfully (the host thread is idle
+            # while the chip works).
+            time.sleep(work_ms / 1e3)
+            manager.allreduce(grad.copy()).wait()
+            manager.should_commit()
+
+        for _ in range(3):
+            step()  # warmup: first quorum forms the group
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            step()
+        return steps / (time.perf_counter() - t0)
+    finally:
+        manager.shutdown(wait=False)
+        store.shutdown()
+
+
+def _one_run(lighthouse_addr: str, use_async: bool, rtt_s: float,
+             steps: int, work_ms: float) -> float:
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        futs = [
+            ex.submit(
+                _train_group, g, lighthouse_addr, use_async, rtt_s, steps,
+                work_ms,
+            )
+            for g in range(2)
+        ]
+        rates = [f.result() for f in futs]
+    return min(rates)  # the group rate is gated by the slower member
+
+
+def main() -> None:
+    import argparse
+
+    from torchft_tpu.coordination import LighthouseServer
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rtt-ms", type=float, default=10.0)
+    ap.add_argument("--runs", type=int, default=7)
+    ap.add_argument("--steps", type=int, default=15)
+    ap.add_argument("--work-ms", type=float, default=30.0)
+    args = ap.parse_args()
+
+    async_runs: List[float] = []
+    sync_runs: List[float] = []
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+    try:
+        for _ in range(args.runs):  # interleaved: both see the same drift
+            async_runs.append(
+                _one_run(lighthouse.address(), True, args.rtt_ms / 1e3,
+                         args.steps, args.work_ms)
+            )
+            sync_runs.append(
+                _one_run(lighthouse.address(), False, args.rtt_ms / 1e3,
+                         args.steps, args.work_ms)
+            )
+    finally:
+        lighthouse.shutdown()
+
+    async_runs.sort()
+    sync_runs.sort()
+    a_med = async_runs[len(async_runs) // 2]
+    s_med = sync_runs[len(sync_runs) // 2]
+    print(json.dumps({
+        "async_steps_per_sec": round(a_med, 3),
+        "sync_steps_per_sec": round(s_med, 3),
+        "async_gain_pct": round((a_med / s_med - 1) * 100.0, 2),
+        "async_runs": [round(r, 3) for r in async_runs],
+        "sync_runs": [round(r, 3) for r in sync_runs],
+        "async_spread_pct": round(
+            (async_runs[-1] - async_runs[0]) / a_med * 100.0, 1
+        ),
+        "sync_spread_pct": round(
+            (sync_runs[-1] - sync_runs[0]) / s_med * 100.0, 1
+        ),
+        "config": f"2 groups, host TCP plane, synthetic +{args.rtt_ms} ms "
+        f"RTT on the quorum RPC, {args.work_ms} ms forward, interleaved "
+        f"median of {args.runs}",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,196 @@
+"""Two replica-group PROCESSES time-sharing the real TPU chip.
+
+Round-4 review weak #7/#8: ``cpu_mesh_2group`` is a CPU proxy and the
+r02 "~2% on-chip" figure predates the native plane. This row runs the
+real topology this box supports: two OS processes, each driving the one
+tunneled chip (the tunnel time-multiplexes clients), cross-group
+averaging over the HOST plane (CMA/TCP). The device-dist plane cannot
+run here — the axon tunnel plugin ignores multi-controller
+``jax.distributed`` (each process still sees process_count()==1, so a
+2-process cohort can never own >= 1 device each); that constraint is
+itself a finding this row records.
+
+A second box constraint shapes the model size: the tunnel moves
+device<->host arrays at ~20 MB/s (measured: 20-35 s/step for the
+58M-param headline model's 234 MB gradient round trip), so full-size
+host-plane averaging of on-chip grads is tunnel-bound, not
+averaging-bound. On a real v5e host D2H is PCIe-fast and the wire cost
+is what cpu_mesh_2group / crossgroup_host_plane price; THIS row
+therefore uses a small model (~2M params, 9 MB grads) so the numbers
+mean "chip time-sharing + averaging", not "tunnel RPC bandwidth".
+
+Protocol: first a SINGLE group at the same per-group batch measures the
+solo rate R1 (own process, chip to itself). Then two groups run
+concurrently; ideal time-sharing with free averaging would give each
+R1/2. The reported overhead is how far the slower group falls below
+that ideal.
+
+Run: ``python -m torchft_tpu.benchmarks.tpu_2group`` — prints one JSON
+line. Internal worker mode: ``--worker`` (driven by main()).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from datetime import timedelta
+
+_STEPS = 6
+_WARMUP = 2
+_BATCH = 4  # per group
+_SEQ = 512
+
+
+def _worker(min_groups: int, lighthouse_addr: str, gid: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torchft_tpu.collectives import CollectivesTcp
+    from torchft_tpu.ddp import allreduce_gradients
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.models.transformer import TransformerConfig
+    from torchft_tpu.parallel.mesh import MeshConfig, make_mesh
+    from torchft_tpu.parallel.train_step import TrainStep
+    from torchft_tpu.store import StoreServer
+
+    # small on purpose: grads must fit the tunnel's ~20 MB/s D2H (see
+    # module docstring) or the row measures the tunnel, not the framework
+    cfg = TransformerConfig(
+        vocab_size=8192, d_model=128, n_layers=2, n_heads=4,
+        head_dim=32, d_ff=384, dtype=jnp.bfloat16,
+    )
+    store = StoreServer()
+    manager = Manager(
+        collectives=CollectivesTcp(timeout=timedelta(seconds=60)),
+        load_state_dict=lambda s: None,
+        state_dict=lambda: {},
+        min_replica_size=min_groups,
+        replica_id=f"tpu2g_{gid}",
+        store_addr=store.address(),
+        rank=0,
+        world_size=1,
+        lighthouse_addr=lighthouse_addr,
+        timeout=timedelta(seconds=60),
+    )
+    try:
+        mesh = make_mesh(MeshConfig(dp=1))
+        ts = TrainStep(cfg, optax.adamw(3e-4), mesh)
+        params = ts.init_params(jax.random.PRNGKey(0))
+        opt_state = ts.init_opt(params)
+        rng = np.random.default_rng(gid)
+        tokens = ts.shard_batch(
+            jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (_BATCH, _SEQ)), jnp.int32
+            )
+        )
+
+        def ft_step(params, opt_state):
+            manager.start_quorum()
+            loss, grads = ts.grads(params, tokens)
+            grads = allreduce_gradients(manager, grads)
+            if manager.should_commit():
+                params, opt_state = ts.apply(params, opt_state, grads)
+            return loss, params, opt_state
+
+        for _ in range(_WARMUP):
+            loss, params, opt_state = ft_step(params, opt_state)
+        float(loss)  # host fence (tunnel: block_until_ready lies)
+        t0 = time.perf_counter()
+        for _ in range(_STEPS):
+            loss, params, opt_state = ft_step(params, opt_state)
+        float(loss)
+        sps = _STEPS / (time.perf_counter() - t0)
+        print(json.dumps({
+            "steps_per_sec": round(sps, 4),
+            "plane": manager._collectives.plane_info()
+            if hasattr(manager._collectives, "plane_info") else "?",
+        }))
+    finally:
+        manager.shutdown(wait=False)
+        store.shutdown()
+
+
+def _spawn(min_groups: int, lighthouse_addr: str, gid: int):
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "torchft_tpu.benchmarks.tpu_2group",
+            "--worker", str(min_groups), lighthouse_addr, str(gid),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        env=dict(os.environ),
+    )
+
+
+def _collect(procs, timeout_s: float):
+    outs = []
+    deadline = time.monotonic() + timeout_s
+    try:
+        for p in procs:
+            out, _ = p.communicate(
+                timeout=max(1.0, deadline - time.monotonic())
+            )
+            if p.returncode != 0:
+                raise RuntimeError(f"worker rc={p.returncode}")
+            outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+        return outs
+    except BaseException:
+        # a failed/timed-out worker must not leave its sibling running
+        # against the single chip while bench.py moves to the next extra
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        raise
+
+
+def main() -> None:
+    from torchft_tpu.coordination import LighthouseServer
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        _worker(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]))
+        return
+
+    # solo reference: same per-group batch, chip to itself
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=1)
+    try:
+        solo = _collect([_spawn(1, lighthouse.address(), 0)], 600)[0]
+    finally:
+        lighthouse.shutdown()
+
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+    try:
+        pair = _collect(
+            [_spawn(2, lighthouse.address(), g) for g in range(2)], 900
+        )
+    finally:
+        lighthouse.shutdown()
+
+    r1 = solo["steps_per_sec"]
+    pair_rates = sorted(p["steps_per_sec"] for p in pair)
+    ideal = r1 / 2.0
+    print(json.dumps({
+        "solo_steps_per_sec": r1,
+        "pair_steps_per_sec": pair_rates,
+        "pair_combined_tokens_per_sec": round(
+            sum(pair_rates) * _BATCH * _SEQ
+        ),
+        "overhead_vs_timeshare_pct": round(
+            (1.0 - pair_rates[0] / ideal) * 100.0, 1
+        ),
+        "plane": pair[0]["plane"],
+        "config": f"2 processes x 1 real chip (tunnel time-multiplexed), "
+        f"d128 L2 b{_BATCH} s{_SEQ} per group (~2M params), full-gradient "
+        f"host-plane averaging; overhead is vs ideal R_solo/2 and is an "
+        f"UPPER bound (the ~27 MB/step tunnel transfer does not halve "
+        f"with chip time-sharing). Small model because the tunnel's "
+        f"~20 MB/s D2H dominates otherwise; device-dist impossible here "
+        f"(tunnel plugin ignores multi-controller jax.distributed)",
+    }))
+
+
+if __name__ == "__main__":
+    main()

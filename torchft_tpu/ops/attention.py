@@ -93,11 +93,13 @@ def chunked_attention(
         # tiers (9.92 vs 9.44 steps/s at 8k going 4->8), s>=16k prefers
         # 16 (16k: 3.34 vs 3.29 at 8; 32k: 1046 ms at 16 vs 1089 at 8)
         tiers = 16 if s >= 16384 else 4
-        # the divisibility gate below would otherwise silently drop
-        # tiering for s values the pick doesn't divide — fall to the
-        # largest compatible tier count instead
-        while tiers > 1 and s % (tiers * chunk) != 0:
-            tiers -= 1
+    # the divisibility gate below would otherwise silently drop tiering
+    # for (s, chunk) pairs the pick doesn't divide — fall to the largest
+    # compatible tier count instead. Applies to EXPLICIT tier counts too:
+    # an env override hitting the gate would otherwise disable tiering
+    # entirely rather than degrade gracefully (round-5 review).
+    while tiers > 1 and s % (tiers * chunk) != 0:
+        tiers -= 1
     scale = d**-0.5
 
     def scan_segment(q_seg: jnp.ndarray, k_seg, v_seg, q0: int) -> jnp.ndarray:
