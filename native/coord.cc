@@ -38,6 +38,7 @@ Value QuorumMember::to_value() const {
   v.set("world_size", Value::I((int64_t)world_size));
   v.set("shrink_only", Value::B(shrink_only));
   v.set("commit_failures", Value::I(commit_failures));
+  v.set("plane", Value::S(plane));
   return v;
 }
 
@@ -50,6 +51,7 @@ QuorumMember QuorumMember::from_value(const Value& v) {
   m.world_size = (uint64_t)v.geti("world_size");
   m.shrink_only = v.getb("shrink_only");
   m.commit_failures = v.geti("commit_failures", 0);
+  m.plane = v.has("plane") ? v.gets("plane") : "";
   return m;
 }
 
@@ -362,6 +364,7 @@ void Lighthouse::quorum_tick() {
   // only reconfigure via membership change, i.e. process restart).
   bool flush = false;
   for (const auto& m : *met) flush = flush || m.commit_failures > 0;
+  if (flush) flush_requests_total_++;
 
   if (!state_.prev_quorum.has_value() ||
       quorum_changed(*met, state_.prev_quorum->participants) || flush) {
@@ -461,6 +464,11 @@ Value Lighthouse::handle_evict(const Value& req) {
   }
   state_.heartbeats.erase(victim);
   state_.participants.erase(victim);
+  evictions_total_++;
+  recent_evictions_.push_back(victim + " < " + reporter + " @ " +
+                              std::to_string(wall_ms() / 1000));
+  if (recent_evictions_.size() > 16)
+    recent_evictions_.erase(recent_evictions_.begin());
   logline("evicted " + victim + " (reported dead by " + reporter +
           ", liveness probe failed)");
   if (running_.load()) quorum_tick();
@@ -539,6 +547,25 @@ static std::string prom_escape(const std::string& s) {
   return out;
 }
 
+static std::string json_escape(const std::string& s) {
+  // JSON string-body escaping (prom_escape is a Prometheus label escaper
+  // and lets control chars other than \n through raw — a tab in a
+  // user-chosen replica_id would break /status.json)
+  std::ostringstream o;
+  for (unsigned char c : s) {
+    if (c == '\\' || c == '"') {
+      o << '\\' << c;
+    } else if (c < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof buf, "\\u%04x", c);
+      o << buf;
+    } else {
+      o << c;
+    }
+  }
+  return o.str();
+}
+
 static std::string http_ok(const std::string& body,
                            const std::string& ctype = "text/html") {
   std::ostringstream o;
@@ -563,14 +590,18 @@ std::string Lighthouse::status_html() {
     int64_t age_ms = wall_ms() - state_.prev_quorum->created_unix_ms;
     o << "<p>age: " << age_ms / 1000.0 << "s</p>";
     o << "<table border=1 cellpadding=4><tr><th>replica_id</th><th>step</th>"
-         "<th>manager</th><th>store</th><th>world_size</th><th></th></tr>";
+         "<th>plane</th><th>manager</th><th>store</th><th>world_size</th>"
+         "<th>flush</th><th></th></tr>";
     for (const auto& p : state_.prev_quorum->participants) {
       bool recovering = p.step != max_step;
       o << "<tr" << (recovering ? " style=\"background:orange\"" : "") << "><td>"
         << html_escape(p.replica_id) << (recovering ? " (recovering)" : "")
-        << "</td><td>" << p.step << "</td><td>" << html_escape(p.address)
+        << "</td><td>" << p.step << "</td><td>"
+        << html_escape(p.plane.empty() ? "?" : p.plane) << "</td><td>"
+        << html_escape(p.address)
         << "</td><td>" << html_escape(p.store_address) << "</td><td>"
-        << p.world_size << "</td><td><form method=post action=\"/replica/"
+        << p.world_size << "</td><td>" << p.commit_failures
+        << "</td><td><form method=post action=\"/replica/"
         << html_escape(p.replica_id)
         << "/kill\"><button>Kill</button></form></td></tr>";
     }
@@ -588,6 +619,16 @@ std::string Lighthouse::status_html() {
       << "s</td></tr>";
   }
   o << "</table>";
+  o << "<h2>FT events</h2><p>evictions: " << evictions_total_
+    << " | data-plane flush re-quorums: " << flush_requests_total_ << "</p>";
+  if (!recent_evictions_.empty()) {
+    o << "<table border=1 cellpadding=4><tr><th>recent evictions "
+         "(victim &lt; reporter @ unix s)</th></tr>";
+    for (auto it = recent_evictions_.rbegin(); it != recent_evictions_.rend();
+         ++it)
+      o << "<tr><td>" << html_escape(*it) << "</td></tr>";
+    o << "</table>";
+  }
   return o.str();
 }
 
@@ -624,10 +665,25 @@ std::string Lighthouse::handle_http(const std::string& method,
         << "torchft_quorum_age_seconds "
         << (wall_ms() - state_.prev_quorum->created_unix_ms) / 1000.0 << "\n"
         << "# TYPE torchft_member_step gauge\n";
+      int64_t mstep = -1, recovering = 0;
       for (const auto& p : state_.prev_quorum->participants)
+        mstep = std::max(mstep, p.step);
+      for (const auto& p : state_.prev_quorum->participants) {
+        if (p.step != mstep) recovering++;
         o << "torchft_member_step{replica_id=\""
           << prom_escape(p.replica_id) << "\"} " << p.step << "\n";
+      }
+      o << "# TYPE torchft_member_info gauge\n";
+      for (const auto& p : state_.prev_quorum->participants)
+        o << "torchft_member_info{replica_id=\"" << prom_escape(p.replica_id)
+          << "\",plane=\"" << prom_escape(p.plane) << "\"} 1\n";
+      o << "# TYPE torchft_recovering_members gauge\n"
+        << "torchft_recovering_members " << recovering << "\n";
     }
+    o << "# TYPE torchft_evictions_total counter\n"
+      << "torchft_evictions_total " << evictions_total_ << "\n"
+      << "# TYPE torchft_flush_requests_total counter\n"
+      << "torchft_flush_requests_total " << flush_requests_total_ << "\n";
     o << "# TYPE torchft_heartbeat_age_seconds gauge\n";
     for (const auto& [id, beat] : state_.heartbeats)
       o << "torchft_heartbeat_age_seconds{replica_id=\"" << prom_escape(id)
@@ -640,7 +696,34 @@ std::string Lighthouse::handle_http(const std::string& method,
     o << "{\"quorum_id\":" << state_.quorum_id << ",\"num_participants\":"
       << (state_.prev_quorum ? (int64_t)state_.prev_quorum->participants.size()
                              : -1)
-      << ",\"heartbeats\":" << state_.heartbeats.size() << "}";
+      << ",\"heartbeats\":" << state_.heartbeats.size()
+      << ",\"evictions_total\":" << evictions_total_
+      << ",\"flush_requests_total\":" << flush_requests_total_;
+    if (state_.prev_quorum) {
+      int64_t mstep = -1;
+      for (const auto& p : state_.prev_quorum->participants)
+        mstep = std::max(mstep, p.step);
+      o << ",\"max_step\":" << mstep << ",\"members\":[";
+      bool first = true;
+      for (const auto& p : state_.prev_quorum->participants) {
+        if (!first) o << ",";
+        first = false;
+        o << "{\"replica_id\":\"" << json_escape(p.replica_id)
+          << "\",\"step\":" << p.step << ",\"plane\":\""
+          << json_escape(p.plane) << "\",\"recovering\":"
+          << (p.step != mstep ? "true" : "false")
+          << ",\"commit_failures\":" << p.commit_failures << "}";
+      }
+      o << "]";
+    }
+    o << ",\"recent_evictions\":[";
+    bool first = true;
+    for (const auto& ev : recent_evictions_) {
+      if (!first) o << ",";
+      first = false;
+      o << "\"" << json_escape(ev) << "\"";
+    }
+    o << "]}";
     return http_ok(o.str(), "application/json");
   }
   // POST /replica/{id}/kill → forward to that replica's manager
@@ -797,6 +880,7 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
   participants_.insert(rank);
   pending_commit_failures_ =
       std::max(pending_commit_failures_, req.geti("commit_failures", 0));
+  if (req.has("plane")) pending_plane_ = req.gets("plane");
   uint64_t seen = quorum_seq_;
 
   if (participants_.size() >= world_size_) {
@@ -810,6 +894,7 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
     me.world_size = world_size_;
     me.shrink_only = req.getb("shrink_only");
     me.commit_failures = pending_commit_failures_;
+    me.plane = pending_plane_;
     pending_commit_failures_ = 0;
     Value lreq = Value::M();
     lreq.set("requester", me.to_value());

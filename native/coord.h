@@ -37,6 +37,11 @@ struct QuorumMember {
   int64_t step = 0;
   uint64_t world_size = 0;
   bool shrink_only = false;
+  // Which transport carries this group's large allreduces (reported by
+  // the Python Manager: "cma" | "tcp-striped" | "python-ring" | "device"
+  // ...); surfaced on the dashboard/metrics so an operator can see a
+  // group that silently fell back to a slower plane (round-4 review).
+  std::string plane;
   // Data-plane flush request (extension beyond the reference): a group whose
   // collectives latched an error asks for a quorum_id bump so EVERY group
   // reconfigures into a fresh rendezvous epoch — the reference can only
@@ -160,6 +165,10 @@ class Lighthouse {
   uint64_t quorum_seq_ = 0;          // bumps every published quorum
   std::map<uint64_t, Quorum> published_;  // seq -> quorum (last few kept)
   std::string last_reason_;
+  // FT runtime observability (round-5: dashboard shows evictions/flushes)
+  int64_t evictions_total_ = 0;
+  int64_t flush_requests_total_ = 0;
+  std::vector<std::string> recent_evictions_;  // "victim < reporter @ unix_s"
 
   std::atomic<bool> running_{true};
   std::thread tick_thread_;
@@ -202,6 +211,7 @@ class ManagerSrv {
   std::map<int64_t, std::string> checkpoint_metadata_;
   std::set<int64_t> participants_;
   int64_t pending_commit_failures_ = 0;  // max over this round's ranks
+  std::string pending_plane_;  // last plane reported by a local rank
   uint64_t quorum_seq_ = 0;
   std::map<uint64_t, Quorum> quorums_;  // seq -> delivered quorum
   std::optional<std::string> quorum_error_;  // lighthouse failure fan-out

@@ -126,7 +126,16 @@ def ddp_train_loop(
     import jax
     import optax
 
-    collectives = CollectivesTcp(timeout=timedelta(seconds=10))
+    total_steps = runner.train_loop_args.get("total_steps", total_steps)
+    if runner.train_loop_args.get("device_plane"):
+        # in-process groups over the DEVICE data plane ('ft' psum on the
+        # virtual CPU mesh) instead of host TCP — the chaos soak runs the
+        # same kill-ish schedule on every plane (round-4 review #10)
+        from torchft_tpu.collectives_device import CollectivesDevice
+
+        collectives = CollectivesDevice(timeout=timedelta(seconds=10))
+    else:
+        collectives = CollectivesTcp(timeout=timedelta(seconds=10))
     extra = {}
     if runner.train_loop_args.get("collectives_transport"):
         # heal over the data plane itself (the PGTransport role,
@@ -162,13 +171,27 @@ def ddp_train_loop(
         opt = ManagedOptimizer(manager, optax.sgd(0.05))
         opt.init(_init_params())
         grad_fn = jax.jit(jax.grad(_loss_fn))
+        # device plane: each group's arrays must live on ITS OWN device
+        # (on hardware each group owns distinct chips; here one device of
+        # the virtual mesh per group). Re-pin every step — a heal hands
+        # back host arrays that would otherwise drift to the default
+        # device and collide with the other group's 'ft' stacking.
+        dev = (
+            jax.devices()[runner.replica_id % jax.device_count()]
+            if runner.train_loop_args.get("device_plane")
+            else None
+        )
 
         data_rng = np.random.default_rng(1000 + runner.replica_id * 17 + rank)
         while True:
             opt.begin_step()
             x = data_rng.standard_normal((8, 3)).astype(np.float32)
             y = data_rng.standard_normal((8, 4)).astype(np.float32)
-            grads = grad_fn(opt.params, x, y)
+            if dev is not None:
+                x, y = jax.device_put((x, y), dev)
+                grads = grad_fn(jax.device_put(opt.params, dev), x, y)
+            else:
+                grads = grad_fn(opt.params, x, y)
             opt.step(grads)
 
             if manager.current_step() >= total_steps:

@@ -116,6 +116,74 @@ def test_emit_k8s_manifests():
     assert ports == {"store": STORE_PORT, "coord": COORD_PORT}
 
 
+def test_k8s_apply_status_down_roundtrip(tmp_path, capsys):
+    """Round-5 review missing #1: the k8s story must be runnable, not just
+    templated. Drive the launcher's apply/status/down verbs against a FAKE
+    kubectl that records its invocations and serves canned API JSON; the
+    applied manifests must round-trip through a YAML parser with the
+    session label every verb selects on."""
+    import json as _json
+
+    yaml = pytest.importorskip("yaml")
+
+    from torchft_tpu.launcher import main
+
+    log = tmp_path / "kubectl.log"
+    stdin_copy = tmp_path / "applied.yaml"
+    canned = {
+        "items": [
+            {
+                "kind": "Job",
+                "metadata": {"name": "sess-g0"},
+                "status": {"active": 2, "succeeded": 0, "failed": 1},
+            },
+            {
+                "kind": "Deployment",
+                "metadata": {"name": "sess-lighthouse"},
+                "status": {"availableReplicas": 1},
+            },
+        ]
+    }
+    fake = tmp_path / "kubectl"
+    fake.write_text(
+        "#!/bin/bash\n"
+        f"echo \"$@\" >> {log}\n"
+        "if [ \"$1\" = apply ]; then\n"
+        f"  cat > {stdin_copy}\n"
+        "elif [ \"$1\" = get ]; then\n"
+        f"  cat {tmp_path}/canned.json\n"
+        "fi\n"
+    )
+    fake.chmod(0o755)
+    (tmp_path / "canned.json").write_text(_json.dumps(canned))
+
+    main([
+        "--k8s-apply", "--name", "sess", "--groups", "2",
+        "--kubectl", str(fake), "--", "python", "train.py",
+    ])
+    docs = list(yaml.safe_load_all(stdin_copy.read_text()))
+    assert len(docs) == 6
+    for d in docs:
+        assert d["metadata"]["labels"]["torchft-session"] == "sess", d
+
+    main(["--k8s-status", "--name", "sess", "--kubectl", str(fake)])
+    out = capsys.readouterr().out
+    st = _json.loads(out)
+    assert st["jobs"]["sess-g0"] == {
+        "active": 2, "succeeded": 0, "failed": 1,
+    }
+    assert st["lighthouse"]["sess-lighthouse"] == {"available": 1}
+
+    main(["--k8s-down", "--name", "sess", "--kubectl", str(fake)])
+    lines = log.read_text().splitlines()
+    assert lines[0].startswith("apply -n default -f -")
+    assert "get jobs,deployments -n default -l torchft-session=sess" in lines[1]
+    assert (
+        "delete jobs,services,deployments -n default -l torchft-session=sess"
+        in lines[2]
+    )
+
+
 def test_k8s_worker_bootstrap_hosts_store(monkeypatch):
     """Rank 0's bootstrap must host a reachable KV store and point the
     child at it; a nonzero child exit propagates."""

@@ -58,8 +58,14 @@ def test_recovery_1of4_one_step_envelope():
     """Round-4: with the death watch (socket-FIN-driven evict + early
     re-quorum overlapping the doomed step), killing 1-of-4 groups must
     cost the survivors at most ONE committed step (the reference's
-    product promise, README.md:29-47). The bench box can be contended, so
-    accept <=1 after one retry rather than demanding the usual 0."""
+    product promise, README.md:29-47). The bench box can be contended,
+    so one retry is allowed — but it is LOGGED and every run's envelope
+    lands in the failure message, so a silently-degrading envelope shows
+    up as retry noise in CI history instead of being masked (round-4
+    review weak #6)."""
+    import warnings
+
+    runs = []
     for attempt in range(2):
         r = measure_recovery(
             total_steps=25,
@@ -70,6 +76,17 @@ def test_recovery_1of4_one_step_envelope():
             timeout_s=120.0,
             num_groups=4,
         )
+        runs.append(r.as_dict())
         if r.survivor_steps_lost <= 1:
-            return
-    assert r.survivor_steps_lost <= 1, r.as_dict()
+            break
+        warnings.warn(
+            f"recovery envelope attempt {attempt} exceeded 1 lost step: "
+            f"{runs[-1]} (retrying once; a persistent retry pattern here "
+            "means the envelope is degrading)",
+            stacklevel=1,
+        )
+    assert runs[-1]["survivor_steps_lost"] <= 1, {"all_attempts": runs}
+    # the blackout itself (not just net lost steps) must stay bounded:
+    # the death watch's early re-quorum should land the survivor's first
+    # post-kill commit within ~2 steady steps even on a contended box
+    assert runs[-1]["blackout_steps"] <= 4.0, {"all_attempts": runs}

@@ -252,6 +252,10 @@ class CollectivesDevice(Collectives):
     def rank(self) -> int:
         return self._rank
 
+    def plane_info(self) -> str:
+        """Dashboard label: in-process device mesh ('ft' psum over ICI)."""
+        return "device"
+
     # -- rendezvous plumbing --
 
     def _next_tag(self) -> int:

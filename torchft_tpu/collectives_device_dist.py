@@ -169,6 +169,10 @@ class CollectivesDeviceDist(Collectives):
     def rank(self) -> int:
         return self._rank
 
+    def plane_info(self) -> str:
+        """Dashboard label: ICI psum plane (+TCP p2p side-channel)."""
+        return "device-dist"
+
     # -- plumbing --
 
     def _cached_jit(self, key: Tuple, body, replicated_out: bool = False,

@@ -173,11 +173,14 @@ class ManagerClient:
         shrink_only: bool,
         timeout: timedelta,
         commit_failures: int = 0,
+        plane: str = "",
     ) -> QuorumResult:
         """``commit_failures > 0`` requests a data-plane flush: the
         lighthouse bumps quorum_id even without membership change, forcing
         every group to re-rendezvous its collectives (extension beyond the
-        reference, which needs a process restart for this)."""
+        reference, which needs a process restart for this). ``plane`` is
+        this group's data-plane transport label, surfaced on the
+        lighthouse dashboard/metrics."""
         resp = self._client.call(
             "mgr.quorum",
             {
@@ -186,6 +189,7 @@ class ManagerClient:
                 "checkpoint_metadata": checkpoint_metadata,
                 "shrink_only": shrink_only,
                 "commit_failures": commit_failures,
+                "plane": plane,
             },
             _ms(timeout),
         )

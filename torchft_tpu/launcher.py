@@ -365,6 +365,26 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         help="internal: in-cluster per-pod bootstrap (store/coordinator "
         "hosting + env completion); used by the emitted manifests",
     )
+    parser.add_argument(
+        "--k8s-apply",
+        action="store_true",
+        help="render the manifests and kubectl-apply them (torchx run "
+        "analogue; kubectl owns auth/context)",
+    )
+    parser.add_argument(
+        "--k8s-status",
+        action="store_true",
+        help="print the session's Job/lighthouse status as JSON "
+        "(selects on the torchft-session label; use --name)",
+    )
+    parser.add_argument(
+        "--k8s-down",
+        action="store_true",
+        help="delete every object of the session (label-selected)",
+    )
+    parser.add_argument(
+        "--kubectl", default="kubectl", help="kubectl binary to shell to"
+    )
     parser.add_argument("--image", default="IMAGE", help="--emit-k8s: container image")
     parser.add_argument("--name", default="torchft", help="--emit-k8s: resource prefix")
     parser.add_argument("--namespace", default="default")
@@ -377,32 +397,55 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     parser.add_argument("cmd", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    logging.basicConfig(level=logging.INFO)
+    if args.k8s_status or args.k8s_down:
+        # cmd-less verbs: operate on an existing session by name
+        import json as _json
+
+        from torchft_tpu.k8s import status, teardown
+
+        if args.k8s_status:
+            print(
+                _json.dumps(
+                    status(
+                        args.name,
+                        namespace=args.namespace,
+                        kubectl=args.kubectl,
+                    ),
+                    indent=1,
+                )
+            )
+        if args.k8s_down:
+            teardown(
+                args.name, namespace=args.namespace, kubectl=args.kubectl
+            )
+        return
     if not cmd:
         parser.error("no command given (use: launcher [opts] -- cmd ...)")
-    logging.basicConfig(level=logging.INFO)
-    if args.emit_k8s:
+    if args.emit_k8s or args.k8s_apply:
         if args.shared_runtime:
-            parser.error("--emit-k8s does not support --shared-runtime yet: "
+            parser.error("--emit-k8s/--k8s-apply do not support --shared-runtime yet: "
                          "the manifests would lack the TORCHFT_COHORT_* "
                          "wiring and workers would silently fall back to "
                          "per-group runtimes")
-        from torchft_tpu.k8s import emit_manifests
+        from torchft_tpu.k8s import emit_manifests, submit
 
-        print(
-            emit_manifests(
-                cmd,
-                name=args.name,
-                image=args.image,
-                num_groups=args.groups,
-                nproc=args.nproc,
-                min_replicas=args.min_replicas,
-                max_restarts=args.max_restarts,
-                namespace=args.namespace,
-                tpu_accelerator=args.tpu_accelerator,
-                tpu_topology=args.tpu_topology,
-            ),
-            end="",
+        manifests = emit_manifests(
+            cmd,
+            name=args.name,
+            image=args.image,
+            num_groups=args.groups,
+            nproc=args.nproc,
+            min_replicas=args.min_replicas,
+            max_restarts=args.max_restarts,
+            namespace=args.namespace,
+            tpu_accelerator=args.tpu_accelerator,
+            tpu_topology=args.tpu_topology,
         )
+        if args.k8s_apply:
+            submit(manifests, namespace=args.namespace, kubectl=args.kubectl)
+        else:
+            print(manifests, end="")
         return
     if args.k8s_worker:
         sys.exit(k8s_worker(cmd))

@@ -335,6 +335,14 @@ class Manager:
             # latched data-plane errors request a flush: quorum_id bumps so
             # all groups (including healthy ones) re-rendezvous together
             commit_failures=self._commit_failures,
+            # data-plane transport label for the lighthouse dashboard —
+            # lets an operator spot a group that fell back to a slower
+            # plane (e.g. CMA broken-latch converging everyone to TCP)
+            plane=(
+                self._collectives.plane_info()
+                if hasattr(self._collectives, "plane_info")
+                else type(self._collectives).__name__
+            ),
         )
 
         # Async quorum overlaps the forward pass, so a healing replica can't
