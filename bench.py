@@ -196,6 +196,13 @@ def _run_json_subprocess(cmd, timeout_s: float, env_extra=None) -> dict:
 # "Higher is better" fields the cross-round regression gate compares.
 _GATE_FIELDS = ("steps_per_sec", "gb_per_sec", "imgs_per_sec")
 _GATE_TOLERANCE_PCT = 15.0  # past run-to-run spread on this 1-core box
+# The crossgroup wire rows run 2 worker processes + parent on ONE core;
+# their r04->r05 swings were -21%..+769% with immediate isolated re-runs
+# landing back inside the old band (e.g. raw_cma 1.307 -> 1.046 flagged,
+# re-run alone 1.188) — a 15% gate on them is all noise. Wider, still
+# finite: a real transport regression (say, CMA silently off) is >2x.
+_GATE_WIDE_ROWS = {"crossgroup_host_plane"}
+_GATE_WIDE_TOLERANCE_PCT = 40.0
 
 
 def _apply_regression_gate(extra: dict, headline_sps: float) -> None:
@@ -215,7 +222,7 @@ def _apply_regression_gate(extra: dict, headline_sps: float) -> None:
 
     regressions = []
 
-    def gate_row(name: str, row: dict, base_row: dict) -> None:
+    def gate_row(name: str, row: dict, base_row: dict, tol: float) -> None:
         for field in _GATE_FIELDS:
             now, was = row.get(field), base_row.get(field)
             if not (
@@ -224,7 +231,7 @@ def _apply_regression_gate(extra: dict, headline_sps: float) -> None:
                 continue
             delta = (now / was - 1.0) * 100.0
             row[f"delta_vs_prev_pct_{field}"] = round(delta, 1)
-            if delta < -_GATE_TOLERANCE_PCT:
+            if delta < -tol:
                 regressions.append(
                     f"{name}.{field}: {was} -> {now} ({delta:+.1f}%)"
                 )
@@ -233,12 +240,17 @@ def _apply_regression_gate(extra: dict, headline_sps: float) -> None:
         for sub, subrow in row.items():
             base_sub = base_row.get(sub)
             if isinstance(subrow, dict) and isinstance(base_sub, dict):
-                gate_row(f"{name}.{sub}", subrow, base_sub)
+                gate_row(f"{name}.{sub}", subrow, base_sub, tol)
 
     for name, row in extra.items():
         base_row = baseline.get(name)
         if isinstance(row, dict) and isinstance(base_row, dict):
-            gate_row(name, row, base_row)
+            tol = (
+                _GATE_WIDE_TOLERANCE_PCT
+                if name in _GATE_WIDE_ROWS
+                else _GATE_TOLERANCE_PCT
+            )
+            gate_row(name, row, base_row, tol)
     was_h = baseline.get("_headline_steps_per_sec")
     if isinstance(was_h, (int, float)) and was_h:
         delta = (headline_sps / was_h - 1.0) * 100.0

@@ -156,6 +156,11 @@ def _worker(factory, store_addr, rank, world_size, tx, rx) -> None:
 class CollectivesProxy(Collectives):
     """Run a Collectives backend in a kill-safe child process."""
 
+    def plane_info(self) -> str:
+        # the inner backend lives in the child; label the isolation layer
+        # itself (querying the child per quorum isn't worth an RPC)
+        return "proxy"
+
     def __init__(
         self,
         factory: Callable[[], Collectives],
