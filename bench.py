@@ -225,6 +225,15 @@ def _apply_regression_gate(extra: dict, headline_sps: float) -> None:
     def gate_row(name: str, row: dict, base_row: dict, tol: float) -> None:
         for field in _GATE_FIELDS:
             now, was = row.get(field), base_row.get(field)
+            if isinstance(was, (int, float)) and was and now is None:
+                # a previously-measured row lost its metric (worker error
+                # or vanished key): exactly the silent loss the gate is
+                # for — flag loudly instead of skipping
+                regressions.append(
+                    f"{name}.{field}: {was} -> MISSING "
+                    f"({row.get('error', 'field absent')})"
+                )
+                continue
             if not (
                 isinstance(now, (int, float)) and isinstance(was, (int, float))
             ) or not was:
@@ -263,15 +272,15 @@ def _apply_regression_gate(extra: dict, headline_sps: float) -> None:
     extra["regressions"] = regressions
 
 
-def main() -> None:
-    import jax
+def headline_config():
+    """The ONE headline model config — also imported by the subprocess
+    workers (benchmarks/long_context.py) so the long-context rows can
+    never silently diverge from the headline model."""
     import jax.numpy as jnp
 
     from torchft_tpu.models.transformer import TransformerConfig
 
-    on_tpu = jax.devices()[0].platform != "cpu"
-
-    cfg = TransformerConfig(
+    return TransformerConfig(
         vocab_size=32000,
         d_model=512,
         n_layers=8,
@@ -280,6 +289,14 @@ def main() -> None:
         d_ff=1408,
         dtype=jnp.bfloat16,
     )
+
+
+def main() -> None:
+    import jax
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+
+    cfg = headline_config()
     batch, seq = (8, 1024) if on_tpu else (4, 128)
     steps, warmup = (20, 3) if on_tpu else (5, 1)
 
@@ -343,81 +360,6 @@ def main() -> None:
         },
     }
 
-    # long-context variants (TPU only): the auto rule routes s>=4096 to
-    # tiered chunked-scan attention (ops/attention.chunked_attention) —
-    # per-block fused scores, static causal k-prefix tiers; round-4 took
-    # s=8192 from 15.0% to ~31% MFU and made s=32k L=8 single-chip viable
-    if on_tpu:
-        attn_note = (
-            "tiered chunked-scan attention (pure XLA; see "
-            "ops/attention.chunked_attention + transformer._use_chunked)"
-        )
-        lc_batch, lc_seq = 2, 4096
-        lc_sps, _ = train_bench(cfg, lc_batch, lc_seq, 10, 2, averaging=True)
-        lc_flops = _model_flops_per_step(cfg, n_params, lc_batch, lc_seq)
-        extra["long_context_s4096"] = {
-            "steps_per_sec": round(lc_sps, 4),
-            "tokens_per_sec": round(lc_sps * lc_batch * lc_seq),
-            "mfu_pct": round(lc_sps * lc_flops / peak * 100.0, 2) if peak else None,
-            "attention": attn_note,
-        }
-        xl_sps, _ = train_bench(cfg, 1, 8192, 6, 2, averaging=True)
-        xl_flops = _model_flops_per_step(cfg, n_params, 1, 8192)
-        extra["long_context_s8192"] = {
-            "steps_per_sec": round(xl_sps, 4),
-            "tokens_per_sec": round(xl_sps * 8192),
-            "mfu_pct": round(xl_sps * xl_flops / peak * 100.0, 2) if peak else None,
-            "attention": attn_note,
-        }
-        xxl_sps, _ = train_bench(cfg, 1, 16384, 4, 2, averaging=True)
-        xxl_flops = _model_flops_per_step(cfg, n_params, 1, 16384)
-        extra["long_context_s16384"] = {
-            "steps_per_sec": round(xxl_sps, 4),
-            "tokens_per_sec": round(xxl_sps * 16384),
-            "mfu_pct": round(xxl_sps * xxl_flops / peak * 100.0, 2)
-            if peak
-            else None,
-            "attention": attn_note,
-        }
-        # s=32k at FULL depth: the memory-ceiling config that previously
-        # fit only the latency-bound pallas path at L<=2 (best-effort: the
-        # tunnel kills any single on-chip program past ~60s)
-        try:
-            xk_sps, _ = train_bench(cfg, 1, 32768, 2, 1, averaging=True)
-            xk_flops = _model_flops_per_step(cfg, n_params, 1, 32768)
-            extra["long_context_s32768"] = {
-                "steps_per_sec": round(xk_sps, 4),
-                "tokens_per_sec": round(xk_sps * 32768),
-                "mfu_pct": round(xk_sps * xk_flops / peak * 100.0, 2)
-                if peak
-                else None,
-                "attention": attn_note,
-            }
-        except Exception as e:  # noqa: BLE001
-            extra["long_context_s32768"] = {"error": str(e)}
-
-    # scale variant (TPU only): the d512 headline model is small enough to
-    # be dispatch/attention-bound; at 647M params the same FT loop shows
-    # the compute ceiling (~45% MFU on v5e)
-    if on_tpu:
-        big = TransformerConfig(
-            vocab_size=32000,
-            d_model=2048,
-            n_layers=12,
-            n_heads=16,
-            head_dim=64,
-            d_ff=5632,
-            dtype=jnp.bfloat16,
-        )
-        big_sps, big_n = train_bench(big, 4, 1024, 8, 2, averaging=True)
-        big_flops = _model_flops_per_step(big, big_n, 4, 1024)
-        extra["scale_647M"] = {
-            "steps_per_sec": round(big_sps, 4),
-            "tokens_per_sec": round(big_sps * 4 * 1024),
-            "n_params": big_n,
-            "mfu_pct": round(big_sps * big_flops / peak * 100.0, 2) if peak else None,
-        }
-
     # ResNet-18 CIFAR (BASELINE.md config list): conv family through the
     # same FT loop; imgs/s per chip. OWN process, first touch of the chip
     # among subprocess extras — round-4's 88->49 "regression" was suite
@@ -431,6 +373,33 @@ def main() -> None:
             )
         except Exception as e:  # noqa: BLE001
             extra["resnet18_cifar"] = {"error": str(e)}
+
+    # long-context variants + the 647M scale variant (TPU only), in their
+    # OWN process (benchmarks/long_context.py): the auto rule routes
+    # s>=1024 to tiered chunked-scan attention; round-4 took s=8192 from
+    # 15.0% to ~31% MFU and round 5 found the in-process rows depressed
+    # ~10% by the headline runs' leftover state — same interference class
+    # as the resnet row, same fix.
+    if on_tpu:
+        try:
+            extra.update(
+                _run_json_subprocess(
+                    [
+                        sys.executable, "-m",
+                        "torchft_tpu.benchmarks.long_context",
+                    ],
+                    timeout_s=1500,
+                )
+            )
+        except Exception as e:  # noqa: BLE001
+            # mark EVERY expected row errored: a vanished row would
+            # silently bypass the regression gate (it only walks keys
+            # present in extra), defeating its purpose
+            for key in (
+                "long_context_s4096", "long_context_s8192",
+                "long_context_s16384", "long_context_s32768", "scale_647M",
+            ):
+                extra[key] = {"error": str(e)}
 
     # sync-vs-async quorum, measured in the regime use_async_quorum exists
     # for: 2 groups + a synthetic RTT on the quorum RPC (round-4 review
