@@ -74,6 +74,20 @@ try:
 except TypeError:
     avg_int = "raised"
 
+# RAGGED reduce_scatter/alltoall (per-slot shapes) can't stack onto the
+# device mesh and must fall back to the p2p side-channel, same results
+rrs = c.reduce_scatter(
+    [np.full(3 + gid_slot, float(gid + 1), np.float32)
+     for gid_slot in range(2)],
+    ReduceOp.SUM,
+).wait()  # rank r owns slot r (shape 3+r): sum = 1+2 = 3.0
+# shapes must be SYMMETRIC (my slot-j shape == rank j's slot-me shape),
+# the same contract the host plane's exchange imposes
+ra2a = c.alltoall(
+    [np.full(2 + gid + j, float(gid * 10 + j), np.float32)
+     for j in range(2)]
+).wait()  # rank r's out[j]: shape 2+j+r, value j*10+r
+
 # cohort mismatch must raise loudly, not deadlock — including a quorum
 # shrunk to ONE on this 2-process runtime (silent singleton no-op
 # allreduces would let partitioned groups diverge)
@@ -98,6 +112,8 @@ with open(out, "w") as f:
         "a2a": [float(x[0]) for x in a2a],
         "p2p": float(rbuf[0]),
         "avg_int": avg_int,
+        "ragged_rs": [len(rrs), float(rrs[0])],
+        "ragged_a2a": [[len(x), float(x[0])] for x in ra2a],
         "mismatch": mismatch,
     }, f)
 """
@@ -157,6 +173,10 @@ def test_two_process_shared_runtime_allreduce(tmp_path):
     # p2p over the host side-channel (what CollectivesTransport heals use)
     assert r0["p2p"] == 7.5 and r1["p2p"] == 3.25
     assert r0["avg_int"] == "raised" and r1["avg_int"] == "raised"
+    # ragged lists fell back to the side-channel with correct results
+    assert r0["ragged_rs"] == [3, 3.0] and r1["ragged_rs"] == [4, 3.0]
+    assert r0["ragged_a2a"] == [[2, 0.0], [3, 10.0]]
+    assert r1["ragged_a2a"] == [[3, 1.0], [4, 11.0]]
     assert r0["mismatch"] == "raised+shrunk-raised", r0["mismatch"]
     assert r1["mismatch"] == "raised+shrunk-raised", r1["mismatch"]
 

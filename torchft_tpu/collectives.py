@@ -140,7 +140,10 @@ class Collectives(ABC):
     @abstractmethod
     def alltoall(self, arrays: List[np.ndarray]) -> Work:
         """Exchange ``arrays[j]`` to rank j; future resolves to the received
-        list in rank order."""
+        list in rank order. Shapes may vary per slot but must be
+        SYMMETRIC: this rank's ``arrays[j]`` shape must equal rank j's
+        ``arrays[this_rank]`` shape (the receive buffer is sized from the
+        local input for that slot)."""
 
     @abstractmethod
     def send(self, arr: np.ndarray, dst: int, tag: int = 0) -> Work: ...
