@@ -258,18 +258,26 @@ def _use_flash(
     )
 
 
-def _attn_chunk() -> int:
-    """Per-call (env-overridable, like every other knob in this file).
-    Round-5 v5e sweep (full-model grads, d512 L8): C=128 beats 256 by
-    ~7% at s=8k and ~15% at s=32k (1046 vs 1241 ms with 16 tiers) and is
-    within noise everywhere else in [1k, 16k] — smaller q-blocks keep the
-    per-block f32 scores fusion-local deeper into the causal prefix."""
+def _attn_chunk(seq_len: int) -> int:
+    """Sequence-aware q-block size; TORCHFT_TPU_ATTN_CHUNK overrides
+    (env-overridable, like every other knob in this file — an
+    unparseable value is IGNORED, not treated as an override).
+    Round-5 v5e sweep (full-model grads / FT-loop steps, d512 L8): C=128
+    beats 256 by ~7% at s=8k and ~15% at s=32k (1046 vs 1241 ms with 16
+    tiers) and is within noise at 1k-2k — smaller q-blocks keep the
+    per-block f32 scores fusion-local deeper into the causal prefix.
+    s=16k is the measured exception: C=256 with 16 tiers runs +6%
+    (3.52 vs 3.33 steps/s, reproduced fresh-process) — at 1k-row
+    segments the halved scan trip count beats the smaller working set."""
     import os
 
-    try:
-        return int(os.environ.get("TORCHFT_TPU_ATTN_CHUNK", "128"))
-    except ValueError:
-        return 128
+    raw = os.environ.get("TORCHFT_TPU_ATTN_CHUNK")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass  # fall through to the sequence-aware default
+    return 256 if seq_len == 16384 else 128
 
 
 def _attn_tiers() -> Optional[int]:
@@ -297,7 +305,7 @@ def _use_chunked(cfg: TransformerConfig, seq_len: int) -> bool:
     manual region, unlike the pallas kernel. Override the engage point
     with TORCHFT_TPU_ATTN_CHUNKED_MIN_S. Sequences not divisible by the
     chunk fall back to plain (both explicit and auto)."""
-    if seq_len % _attn_chunk() != 0:
+    if seq_len % _attn_chunk(seq_len) != 0:
         return False
     if cfg.attention_impl == "chunked":
         return True
@@ -360,7 +368,8 @@ def _make_layer_fn(cfg: TransformerConfig, mesh, sp_manual: bool = False):
             att = ring_attention(q, k, v, mesh, causal=True)
         elif _use_chunked(cfg, s):
             att = chunked_attention(
-                q, k, v, causal=True, chunk=_attn_chunk(), tiers=_attn_tiers()
+                q, k, v, causal=True, chunk=_attn_chunk(s),
+                tiers=_attn_tiers(),
             )
         elif _use_flash(cfg, s, b, mesh):
             # flash needs its own (full) manual region, which can't nest
