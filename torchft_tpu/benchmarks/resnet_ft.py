@@ -8,7 +8,16 @@ gave 72–93 steps/s (median ~85), and re-running it after single variants
 reproduced only noise-range dips — i.e. suite interference plus
 unreported run variance, not a model regression. The fix is structural:
 the row now runs in its OWN process (this module), first touch of the
-chip, median of 3 reps with the runs list recorded.
+chip, median of 5 reps with the runs list recorded.
+
+Round-5 addendum: even isolated, per-invocation medians span 44-96
+steps/s (within-invocation reps 53->98, first rep always lowest). At
+b256 a step is ~10-15 ms against ~5 tunnel RPC round trips (quorum,
+commit, 3 dispatches), so the row is DISPATCH-LATENCY-bound on this
+tunneled box and measures tunnel weather as much as conv throughput —
+the regression gate carries a wide tolerance for it (bench.py), and a
+real conv regression must be judged against the runs list, not the
+median alone.
 
 Run: ``python -m torchft_tpu.benchmarks.resnet_ft`` — prints one JSON
 line.
@@ -19,7 +28,7 @@ import sys
 import time
 
 
-def run(steps: int = 20, warmup: int = 3, batch: int = 256, reps: int = 3) -> dict:
+def run(steps: int = 20, warmup: int = 3, batch: int = 256, reps: int = 5) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -79,8 +88,8 @@ def run(steps: int = 20, warmup: int = 3, batch: int = 256, reps: int = 3) -> di
         "runs_steps_per_sec": [round(r, 4) for r in runs],
         "spread_pct": round((runs[-1] - runs[0]) / sps * 100.0, 1),
         "config": f"resnet18-cifar NHWC bf16 b{batch}, single-group FT "
-        "loop, OWN process (median of 3; see module docstring for the "
-        "round-4 interference post-mortem)",
+        f"loop, OWN process (median of {reps}; dispatch-latency-bound "
+        "through the tunnel — see module docstring for both post-mortems)",
     }
 
 
