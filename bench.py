@@ -492,6 +492,19 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — best-effort secondary metric
             extra[key] = {"error": str(e)}
 
+    # Telemetry snapshot alongside the perf rows: the headline loop above
+    # ran through the REAL instrumented Manager in this process, so the
+    # snapshot records how much FT control traffic (quorums, heals,
+    # allreduce bytes) and what step-time distribution produced these
+    # numbers — perf trajectory and FT behavior land in one BENCH_*.json
+    # row instead of needing a post-mortem rerun.
+    try:
+        from torchft_tpu import telemetry as _telemetry
+
+        extra["telemetry"] = _telemetry.summary()
+    except Exception as e:  # noqa: BLE001 — observability never fails bench
+        extra["telemetry"] = {"error": str(e)}
+
     # The driver tail-captures stdout, so the COMPACT headline must be the
     # LAST line (round-3 verdict weak #1: the r03 headline was truncated
     # away by the verbose extras that followed it).  Verbose extras go to a

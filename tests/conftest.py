@@ -4,6 +4,14 @@ import os
 # sharding is validated without TPU hardware (the driver separately
 # dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Persistent XLA compilation cache, shared with every worker subprocess
+# the soak tests spawn (they inherit the env): the chaos/recovery tiers
+# respawn the same toy models dozens of times and each respawn otherwise
+# recompiles from scratch — on the 2-core CI box that recompile tax alone
+# pushes the full 'not slow' tier against its wall-clock budget.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/tft_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,6 +23,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Polyfill the modern jax API surface (jax.shard_map / jax.set_mesh /
+# jax.sharding.get_abstract_mesh) onto older runtimes; tests use the
+# modern spellings directly.
+import torchft_tpu.utils.jax_compat  # noqa: E402,F401
 
 # Let in-process tests exercise the kill RPC without nuking pytest.
 os.environ.setdefault("TORCHFT_TPU_SOFT_KILL", "1")

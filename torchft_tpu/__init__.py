@@ -40,6 +40,10 @@ __all__ = [
 def __getattr__(name):
     # Heavier wrappers import jax/optax; load lazily so the coordination
     # layer stays importable on lighthouse-only hosts.
+    if name == "telemetry":
+        import torchft_tpu.telemetry as telemetry
+
+        return telemetry
     if name == "ManagedOptimizer":
         from torchft_tpu.optim import ManagedOptimizer
 

@@ -149,9 +149,18 @@ class RecoveryResult:
     steady_step_s: float
     survivor_steps_lost: int
     total_steps: int
+    # FT event-trail digest (event kind -> count across all groups) plus
+    # the raw per-group trail paths, so the envelope numbers above can be
+    # cross-checked against the recorded quorum/heal/peer-death sequence
+    ft_events: Optional[Dict[str, int]] = None
+    trail_paths: Optional[List[str]] = None
+    # unix timestamps of the SIGKILL and the respawn exec — anchors for
+    # correlating trail records with the induced failure
+    t_kill_unix: float = 0.0
+    t_respawn_unix: float = 0.0
 
-    def as_dict(self) -> Dict[str, float]:
-        return {
+    def as_dict(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
             "survivor_blackout_s": round(self.survivor_blackout_s, 3),
             "rejoin_to_commit_s": round(self.rejoin_to_commit_s, 3),
             "steady_step_s": round(self.steady_step_s, 4),
@@ -160,6 +169,9 @@ class RecoveryResult:
             ),
             "survivor_steps_lost": self.survivor_steps_lost,
         }
+        if self.ft_events is not None:
+            out["ft_events"] = self.ft_events
+        return out
 
 
 def _spawn(
@@ -206,16 +218,11 @@ def _spawn(
 
 
 def _read_events(path: str) -> List[Dict]:
-    events = []
-    try:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    events.append(json.loads(line))
-    except FileNotFoundError:
-        pass
-    return events
+    # same JSONL contract as the telemetry trail, including tolerance for
+    # the torn line a SIGKILLed writer leaves behind — share the parser
+    from torchft_tpu.telemetry import read_trail
+
+    return read_trail(path)
 
 
 def _wait_for(path: str, pred, timeout_s: float, procs=()) -> Dict:
@@ -248,6 +255,10 @@ def measure_recovery(
     victim_gid = num_groups - 1
     tmp = tempfile.mkdtemp(prefix="tft_recovery_")
     logs = [os.path.join(tmp, f"g{g}.jsonl") for g in range(num_groups)]
+    # each worker's Manager writes its FT event trail here (telemetry
+    # module, TORCHFT_EVENT_TRAIL env) — the flight-recorder view of the
+    # same kill the wall-clock numbers summarize
+    trails = [os.path.join(tmp, f"g{g}.trail.jsonl") for g in range(num_groups)]
     lighthouse = LighthouseServer(
         bind="[::]:0",
         min_replicas=1,
@@ -265,7 +276,13 @@ def measure_recovery(
     try:
         for g in range(num_groups):
             procs[g] = _spawn(
-                g, {**common, "TORCHFT_EVENT_LOG": logs[g]}, num_groups
+                g,
+                {
+                    **common,
+                    "TORCHFT_EVENT_LOG": logs[g],
+                    "TORCHFT_EVENT_TRAIL": trails[g],
+                },
+                num_groups,
             )
 
         # let the victim reach the kill step
@@ -285,7 +302,12 @@ def measure_recovery(
         # the respawn time is known exactly)
         t_respawn = time.time()
         procs[victim_gid] = _spawn(
-            victim_gid, {**common, "TORCHFT_EVENT_LOG": logs[victim_gid]},
+            victim_gid,
+            {
+                **common,
+                "TORCHFT_EVENT_LOG": logs[victim_gid],
+                "TORCHFT_EVENT_TRAIL": trails[victim_gid],
+            },
             num_groups,
         )
 
@@ -321,12 +343,23 @@ def measure_recovery(
         # committed steps the survivor would have made during the blackout,
         # minus the ones it did make: the "< 1 step" envelope in step units
         lost = max(0, int(blackout / steady_step) - (post["step"] - last_pre_step))
+        from torchft_tpu.telemetry import read_trail
+
+        ft_events: Dict[str, int] = {}
+        for path in trails:
+            for rec in read_trail(path):
+                kind = rec.get("event", "?")
+                ft_events[kind] = ft_events.get(kind, 0) + 1
         return RecoveryResult(
             survivor_blackout_s=blackout,
             rejoin_to_commit_s=rejoin["t"] - t_respawn,
             steady_step_s=steady_step,
             survivor_steps_lost=lost,
             total_steps=total_steps,
+            ft_events=ft_events,
+            trail_paths=list(trails),
+            t_kill_unix=t_kill,
+            t_respawn_unix=t_respawn,
         )
     finally:
         for p in procs:

@@ -20,12 +20,14 @@ from __future__ import annotations
 
 import itertools
 import logging
+import time
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
 from typing import Deque, Generic, List, TypeVar
 
 import numpy as np
 
+from torchft_tpu import telemetry
 from torchft_tpu.checkpointing.serialization import (
     as_bytes,
     buffer_sizes,
@@ -83,6 +85,9 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
         self._collectives = collectives
         self._timeout = timeout
         self._window = max(1, window)
+        # payload size of the last recv_checkpoint — the Manager reads it
+        # for the heal_end event's bytes field
+        self.last_recv_bytes: int = 0
 
     def metadata(self) -> str:
         return "<collectives>"
@@ -117,33 +122,53 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: T, timeout: timedelta
     ) -> None:
+        t0 = time.perf_counter()
         header, buffers = flatten_state(state_dict)
+        nbytes = len(header) + sum(int(b.nbytes) for b in buffers)
+        telemetry.record_checkpoint(
+            "stage", nbytes, time.perf_counter() - t0, "collectives"
+        )
         hdr_arr = np.frombuffer(header, dtype=np.uint8)
         salt = next(_TRANSFER_SALT)
         # the salt rides in the length frame so the receiver tags its
         # windowed recvs identically without an extra round-trip
         len_arr = np.array([len(header), salt], dtype=np.int64)
+        t0 = time.perf_counter()
         if len(dst_ranks) == 1:
             self._send_one(dst_ranks[0], len_arr, hdr_arr, buffers, timeout, salt)
-            return
-        with ThreadPoolExecutor(
-            max_workers=min(_MAX_DST_PARALLEL, len(dst_ranks)),
-            thread_name_prefix="tft_ckpt_send",
-        ) as pool:
-            futs = [
-                pool.submit(
-                    self._send_one, dst, len_arr, hdr_arr, buffers, timeout, salt
-                )
-                for dst in dst_ranks
-            ]
-            for f in futs:
-                f.result()
+        else:
+            with ThreadPoolExecutor(
+                max_workers=min(_MAX_DST_PARALLEL, len(dst_ranks)),
+                thread_name_prefix="tft_ckpt_send",
+            ) as pool:
+                futs = [
+                    pool.submit(
+                        self._send_one, dst, len_arr, hdr_arr, buffers,
+                        timeout, salt,
+                    )
+                    for dst in dst_ranks
+                ]
+                for f in futs:
+                    f.result()
+        seconds = time.perf_counter() - t0
+        telemetry.record_checkpoint(
+            "send", nbytes * len(dst_ranks), seconds, "collectives"
+        )
+        telemetry.emit(
+            "checkpoint_send",
+            transport="collectives",
+            dst_ranks=list(dst_ranks),
+            step=step,
+            bytes=nbytes,
+            duration_s=round(seconds, 4),
+        )
 
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: timedelta
     ) -> T:
         from collections import deque
 
+        t0 = time.perf_counter()
         len_arr = np.zeros(2, dtype=np.int64)
         self._collectives.recv(len_arr, src_rank, tag=_META_TAG).wait(timeout)
         salt = int(len_arr[1])
@@ -166,4 +191,15 @@ class CollectivesTransport(CheckpointTransport[T], Generic[T]):
             )
         while window:
             window.popleft().wait(timeout)
+        seconds = time.perf_counter() - t0
+        nbytes = len(header) + sum(int(b.nbytes) for b in buffers)
+        self.last_recv_bytes = nbytes
+        telemetry.record_checkpoint("recv", nbytes, seconds, "collectives")
+        telemetry.emit(
+            "checkpoint_recv",
+            transport="collectives",
+            step=step,
+            bytes=nbytes,
+            duration_s=round(seconds, 4),
+        )
         return unflatten_state(header, buffers)

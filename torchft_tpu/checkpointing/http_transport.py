@@ -17,8 +17,8 @@ from __future__ import annotations
 import logging
 import socket
 import threading
+import time
 import urllib.request
-from contextlib import contextmanager
 from datetime import timedelta
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from concurrent.futures import ThreadPoolExecutor
@@ -26,6 +26,7 @@ from typing import Generic, List, Optional, Tuple, TypeVar
 
 import numpy as np
 
+from torchft_tpu import telemetry
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.checkpointing.serialization import (
     as_bytes,
@@ -39,15 +40,6 @@ logger = logging.getLogger(__name__)
 T = TypeVar("T")
 
 __all__ = ["HTTPTransport"]
-
-
-@contextmanager
-def _timed(what: str):
-    import time
-
-    t0 = time.perf_counter()
-    yield
-    logger.info("%s took %.3fs", what, time.perf_counter() - t0)
 
 
 class _Server(ThreadingHTTPServer):
@@ -82,6 +74,9 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
         self._timeout = timeout
         self._num_chunks = num_chunks
         self._hostname = hostname or socket.gethostname()
+        # payload size of the last recv_checkpoint — the Manager reads it
+        # for the heal_end event's bytes field
+        self.last_recv_bytes: int = 0
 
         self._lock = RWLock(timeout=timeout.total_seconds())
         self._step: Optional[int] = None
@@ -104,6 +99,22 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
                 pass
 
             def do_GET(self) -> None:
+                # /metrics needs no checkpoint state: serve the process
+                # telemetry BEFORE the staging lock, so a scrape succeeds
+                # even while no checkpoint is staged (readers would block)
+                if self.path.rstrip("/") == "/metrics":
+                    body = telemetry.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    try:
+                        self.wfile.write(body)
+                    except (BrokenPipeError, socket.timeout):
+                        pass
+                    return
                 # bound socket writes so one stalled healing peer can't hold
                 # the read lock forever (which would block the next
                 # disallow_checkpoint and fail should_commit on this side)
@@ -136,13 +147,16 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
                         self.send_error(404, f"bad path {self.path}")
                         return
                     self.send_response(200)
+                    nbytes = sum(len(p) for p in payload)
                     self.send_header("Content-Type", "application/octet-stream")
-                    self.send_header(
-                        "Content-Length", str(sum(len(p) for p in payload))
-                    )
+                    self.send_header("Content-Length", str(nbytes))
                     self.end_headers()
+                    t0 = time.perf_counter()
                     for part in payload:
                         self.wfile.write(part)
+                    telemetry.record_checkpoint(
+                        "send", nbytes, time.perf_counter() - t0, "http"
+                    )
                 except (BrokenPipeError, socket.timeout):
                     pass
                 except Exception as e:  # noqa: BLE001 — report to the peer
@@ -191,8 +205,19 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
         # step aborted before should_commit ran disallow_checkpoint), so
         # staging never races active GET streams
         self.disallow_checkpoint()
-        with _timed("staging checkpoint"):
-            header, buffers = flatten_state(state_dict)
+        t0 = time.perf_counter()
+        header, buffers = flatten_state(state_dict)
+        nbytes = len(header) + sum(int(b.nbytes) for b in buffers)
+        telemetry.record_checkpoint(
+            "stage", nbytes, time.perf_counter() - t0, "http"
+        )
+        telemetry.emit(
+            "checkpoint_send",
+            transport="http",
+            dst_ranks=list(dst_ranks),
+            step=step,
+            bytes=nbytes,
+        )
         self._header = header
         self._buffers = buffers
         nchunks = min(self._num_chunks, len(buffers)) if self._num_chunks else 0
@@ -208,31 +233,43 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
             self._lock.w_acquire()
             self._allowed = False
 
+    def _fetch_full(self, base: str, secs: float, step: int) -> T:
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(f"{base}/full", timeout=secs) as resp:
+            from torchft_tpu.checkpointing.serialization import load_state
+
+            state = load_state(resp)
+            nbytes = int(resp.headers.get("Content-Length") or 0)
+        self._record_recv(nbytes, time.perf_counter() - t0, step)
+        return state
+
+    def _record_recv(self, nbytes: int, seconds: float, step: int) -> None:
+        self.last_recv_bytes = nbytes
+        telemetry.record_checkpoint("recv", nbytes, seconds, "http")
+        telemetry.emit(
+            "checkpoint_recv",
+            transport="http",
+            step=step,
+            bytes=nbytes,
+            duration_s=round(seconds, 4),
+        )
+
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: timedelta
     ) -> T:
         base = f"{metadata}/checkpoint/{step}"
         secs = timeout.total_seconds()
         if self._num_chunks == 0:
-            with _timed("fetching full checkpoint"), urllib.request.urlopen(
-                f"{base}/full", timeout=secs
-            ) as resp:
-                from torchft_tpu.checkpointing.serialization import load_state
-
-                return load_state(resp)
+            return self._fetch_full(base, secs, step)
 
         import pickle
 
+        t0 = time.perf_counter()
         with urllib.request.urlopen(f"{base}/metadata", timeout=secs) as resp:
             header, groups = pickle.loads(resp.read())
         if not groups:
             # sender staged unchunked (its num_chunks=0 wins over ours)
-            with _timed("fetching full checkpoint"), urllib.request.urlopen(
-                f"{base}/full", timeout=secs
-            ) as resp:
-                from torchft_tpu.checkpointing.serialization import load_state
-
-                return load_state(resp)
+            return self._fetch_full(base, secs, step)
         _, infos = pickle.loads(header)
         from torchft_tpu.checkpointing.serialization import buffer_sizes
 
@@ -248,10 +285,12 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
                         raise EOFError(f"truncated chunk {ci}")
                     buffers[j] = np.frombuffer(raw, dtype=np.uint8)
 
-        with _timed("fetching chunked checkpoint"):
-            with ThreadPoolExecutor(max_workers=len(groups) or 1) as pool:
-                for f in [pool.submit(fetch, ci) for ci in range(len(groups))]:
-                    f.result()
+        with ThreadPoolExecutor(max_workers=len(groups) or 1) as pool:
+            for f in [pool.submit(fetch, ci) for ci in range(len(groups))]:
+                f.result()
+        self._record_recv(
+            len(header) + sum(sizes), time.perf_counter() - t0, step
+        )
         return unflatten_state(header, [b for b in buffers if b is not None])
 
     def shutdown(self, wait: bool = True) -> None:

@@ -798,6 +798,9 @@ class CollectivesTcp(Collectives):
             # caller's Work future must still resolve or a timeout-less
             # wait() would hang forever
             if t.cancelled() and not out.done():
+                from torchft_tpu import telemetry
+
+                telemetry.FUTURE_CANCELS.inc()
                 out.set_exception(
                     RuntimeError("collectives reconfigured before op ran")
                 )
@@ -1063,13 +1066,29 @@ class CollectivesTcp(Collectives):
         self._op_seq = (self._op_seq + 1) & 0x00FFFFFF
         return self._op_seq
 
+    def _count_op(self, op_name: str) -> None:
+        from torchft_tpu import telemetry
+
+        telemetry.COLLECTIVE_OPS.labels(
+            op=op_name, plane=self.plane_info()
+        ).inc()
+
     # -- collectives (all run on the op thread, SPMD-ordered) --
 
     def allreduce(self, arrays: List[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
         world, rank = self._world, self._rank
         tag = self._next_tag() | 0x01000000
+        # counted at submission like every other op (uniform semantics);
+        # bytes + latency are recorded at completion in run()
+        self._count_op("allreduce")
+        nbytes = sum(int(a.nbytes) for a in arrays)
 
         def run() -> List[np.ndarray]:
+            import time
+
+            from torchft_tpu import telemetry
+
+            t0 = time.perf_counter()
             if world > 1:
                 # ops are serialized on the op thread, so arrays of one
                 # allreduce may share the tag (it is a desync check, not a
@@ -1081,6 +1100,10 @@ class CollectivesTcp(Collectives):
                         self._ring_allreduce(arr, op, tag)
                         if op == ReduceOp.AVG:
                             np.divide(arr, world, out=arr)
+            telemetry.record_collective(
+                "allreduce", nbytes, time.perf_counter() - t0,
+                self.plane_info(), count_op=False,
+            )
             return arrays
 
         return self._submit(run)
@@ -1179,6 +1202,7 @@ class CollectivesTcp(Collectives):
     def allgather(self, arr: np.ndarray) -> Work:
         world, rank = self._world, self._rank
         tag = self._next_tag() | 0x02000000
+        self._count_op("allgather")
 
         def run() -> List[np.ndarray]:
             out: List[Optional[np.ndarray]] = [None] * world
@@ -1199,6 +1223,7 @@ class CollectivesTcp(Collectives):
     def broadcast(self, arr: np.ndarray, root: int = 0) -> Work:
         world, rank = self._world, self._rank
         tag = self._next_tag() | 0x03000000
+        self._count_op("broadcast")
 
         def run() -> np.ndarray:
             if world > 1:
@@ -1221,6 +1246,7 @@ class CollectivesTcp(Collectives):
         if len(arrays) != world:
             raise ValueError(f"reduce_scatter needs {world} inputs, got {len(arrays)}")
         tag = self._next_tag() | 0x04000000
+        self._count_op("reduce_scatter")
         reduce_fn = _REDUCE_FNS[op]
 
         def run() -> np.ndarray:
@@ -1256,6 +1282,7 @@ class CollectivesTcp(Collectives):
         if len(arrays) != world:
             raise ValueError(f"alltoall needs {world} inputs, got {len(arrays)}")
         tag = self._next_tag() | 0x05000000
+        self._count_op("alltoall")
 
         def run() -> List[np.ndarray]:
             out: List[Optional[np.ndarray]] = [None] * world
@@ -1278,6 +1305,7 @@ class CollectivesTcp(Collectives):
 
     def send(self, arr: np.ndarray, dst: int, tag: int = 0) -> Work:
         wire_tag = 0x06000000 | (tag & 0xFFFFFF)
+        self._count_op("send")
 
         def run() -> None:
             self._send_to(dst, wire_tag, _bytes_view(arr))
@@ -1286,6 +1314,7 @@ class CollectivesTcp(Collectives):
 
     def recv(self, arr: np.ndarray, src: int, tag: int = 0) -> Work:
         wire_tag = 0x06000000 | (tag & 0xFFFFFF)
+        self._count_op("recv")
 
         def run() -> np.ndarray:
             _flat_view(arr)  # contiguity check up front, like the old path
@@ -1299,6 +1328,7 @@ class CollectivesTcp(Collectives):
         token = np.zeros(1, dtype=np.int32)
         world = self._world
         tag = self._next_tag() | 0x07000000
+        self._count_op("barrier")
 
         def run() -> None:
             if world > 1:

@@ -140,6 +140,8 @@ def _reduction_fn(mesh, specs: Tuple, op: ReduceOp, world: int) -> Callable:
     (mesh, specs, op, world) so steady-state steps never recompile."""
     import jax
 
+    import torchft_tpu.utils.jax_compat  # noqa: F401 — polyfills older jax
+
     key = (mesh, specs, op, world)
     with _PSUM_CACHE_LOCK:
         fn = _PSUM_CACHE.get(key)
@@ -267,6 +269,10 @@ class CollectivesDevice(Collectives):
         group to arrive computes and resolves everyone's future."""
         ep = self._epoch
         assert ep is not None, "configure() must be called first"
+        if kind != "allreduce":  # allreduce accounts bytes+latency itself
+            from torchft_tpu import telemetry
+
+            telemetry.COLLECTIVE_OPS.labels(op=kind, plane="device").inc()
         tag = self._next_tag()
         fut: Future = Future()
         run_op: Optional[_Op] = None
@@ -309,11 +315,35 @@ class CollectivesDevice(Collectives):
     # -- collectives --
 
     def allreduce(self, arrays: List[Any], op: ReduceOp = ReduceOp.SUM) -> Work:
+        import time
+
+        from torchft_tpu import telemetry
+
         arrays = [_as_device(a) for a in arrays]
+        nbytes = sum(int(a.nbytes) for a in arrays)
         if self._world == 1:
-            # sum/avg/max/min of one input is itself; no timer registration
+            # sum/avg/max/min of one input is itself; no timer registration.
+            # Count the op + bytes but record NO latency observation — a
+            # hard-coded 0.0 for the no-op path would drown the histogram's
+            # real cross-group latencies
+            telemetry.COLLECTIVE_OPS.labels(op="allreduce", plane="device").inc()
+            telemetry.ALLREDUCE_BYTES.labels(plane="device").inc(nbytes)
             return Work(Future.completed(arrays))
-        return self._rendezvous("allreduce", arrays, (op,))
+        telemetry.COLLECTIVE_OPS.labels(op="allreduce", plane="device").inc()
+        t0 = time.perf_counter()
+        work = self._rendezvous("allreduce", arrays, (op,))
+
+        def observe(f: Future) -> None:
+            # dispatch latency of the cross-group rendezvous + psum launch
+            # (device work is async; completion is fenced by the caller)
+            if f.exception() is None:
+                telemetry.record_collective(
+                    "allreduce", nbytes, time.perf_counter() - t0, "device",
+                    count_op=False,
+                )
+
+        work.get_future().then(observe)
+        return work
 
     def allgather(self, arr: Any) -> Work:
         return self._rendezvous("allgather", _as_device(arr))

@@ -181,6 +181,11 @@ class ManagerClient:
         reference, which needs a process restart for this). ``plane`` is
         this group's data-plane transport label, surfaced on the
         lighthouse dashboard/metrics."""
+        import time
+
+        from torchft_tpu import telemetry
+
+        t0 = time.perf_counter()
         resp = self._client.call(
             "mgr.quorum",
             {
@@ -193,6 +198,10 @@ class ManagerClient:
             },
             _ms(timeout),
         )
+        # the RPC long-polls until the lighthouse forms the quorum, so
+        # this duration IS quorum-formation latency as this rank saw it
+        telemetry.QUORUM_LATENCY.observe(time.perf_counter() - t0)
+        telemetry.QUORUMS_TOTAL.inc()
         return QuorumResult._from_wire(resp)
 
     def _checkpoint_metadata(self, rank: int, timeout: timedelta) -> str:

@@ -169,6 +169,9 @@ class _TimeoutManager:
                 heapq.heappop(self._heap)
             fut = slot[0]
             if fut is not None and not fut.done():
+                from torchft_tpu import telemetry
+
+                telemetry.FUTURE_TIMEOUTS.inc()
                 fut.set_exception(
                     TimeoutError("future did not complete within deadline")
                 )

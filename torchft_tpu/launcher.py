@@ -113,6 +113,7 @@ def launch_shared_runtime(
     num_groups: int = 2,
     lighthouse_addr: Optional[str] = None,
     max_restarts: int = 10,
+    restart_backoff_s: float = 6.0,
 ) -> int:
     """Run ``cmd`` as ``num_groups`` single-process replica groups joined
     to ONE multi-controller JAX runtime (``CollectivesDeviceDist``: the
@@ -166,9 +167,19 @@ def launch_shared_runtime(
                     exit_code = 1
                     break
                 restarts += 1
+                # let the dead incarnation's heartbeat leases lapse at the
+                # lighthouse before the new cohort joins: an immediate
+                # respawn forms a quorum that still contains the stale
+                # replica_ids, the device-dist plane refuses the cohort
+                # mismatch (quorum N+stale vs runtime N), the fresh
+                # workers die, and each cycle re-arms the race — the
+                # restart budget burns without ever converging. Default
+                # sits just above the lighthouse's 5 s default lease.
                 logger.info(
-                    "restarting cohort (restart %d/%d)", restarts, max_restarts
+                    "restarting cohort (restart %d/%d) after %.1fs lease "
+                    "backoff", restarts, max_restarts, restart_backoff_s,
                 )
+                time.sleep(restart_backoff_s)
                 spawn_cohort()
     except KeyboardInterrupt:
         exit_code = 130

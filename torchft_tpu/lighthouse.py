@@ -38,8 +38,11 @@ def main(argv=None) -> None:
         quorum_tick_ms=args.quorum_tick_ms,
         heartbeat_timeout_ms=args.heartbeat_timeout_ms,
     )
-    logging.info("lighthouse listening on %s (dashboard at the same address)",
-                 server.address())
+    logging.info(
+        "lighthouse listening on %s (dashboard at /, Prometheus exposition "
+        "at /metrics, JSON counters at /status.json)",
+        server.address(),
+    )
 
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())

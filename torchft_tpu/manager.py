@@ -34,11 +34,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, TypeVar, cast
 
 import numpy as np
 
+from torchft_tpu import telemetry
 from torchft_tpu.checkpointing.http_transport import HTTPTransport
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.collectives import Collectives, ReduceOp
 from torchft_tpu.coordination import ManagerClient, ManagerServer
 from torchft_tpu.futures import Future, future_timeout
+from torchft_tpu.profiling import StepTimer
 from torchft_tpu.store import StoreClient
 
 T = TypeVar("T")
@@ -239,6 +241,10 @@ class Manager:
         self._group_healing = False
         self._pending_work: List[Future] = []
         self._batches_committed = 0
+        # rolling steps/sec with quorum/heal steps tagged as outliers;
+        # should_commit ticks it, so its outlier durations are the
+        # recorded per-step recovery cost (telemetry step_outlier events)
+        self.step_timer = StepTimer()
 
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
@@ -281,6 +287,12 @@ class Manager:
         self._group_healing = False
         self._step_epochs = set()
         self._step_n = None
+        telemetry.emit(
+            "quorum_start",
+            step=self._step,
+            allow_heal=allow_heal,
+            shrink_only=shrink_only,
+        )
 
         # hold the lock across wait+replace: a death-watch submission
         # sliding in between would be silently overwritten (its exception
@@ -326,6 +338,9 @@ class Manager:
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: timedelta
     ) -> None:
+        import time as _time
+
+        t_quorum = _time.perf_counter()
         quorum = self._client._quorum(
             rank=self._rank,
             step=self._step,
@@ -369,8 +384,28 @@ class Manager:
             ):
                 self._participating_rank = None
 
+        prev_participants = self._participant_ids
         self._participant_ids = quorum.participant_ids
         self._evicted.clear()
+
+        telemetry.PARTICIPANTS.set(self._participating_world_size)
+        # prev_participants is [] before the first quorum: joining is not
+        # membership CHURN, so don't count it (a cohort restart would
+        # otherwise record N phantom changes)
+        if prev_participants and set(quorum.participant_ids) != set(
+            prev_participants
+        ):
+            telemetry.MEMBERSHIP_CHANGES.inc()
+        telemetry.emit(
+            "quorum_ready",
+            quorum_id=quorum.quorum_id,
+            step=self._step,
+            participants=list(quorum.participant_ids),
+            num_participants=self._participating_world_size,
+            heal=quorum.heal,
+            reconfigure=quorum.quorum_id != self._quorum_id,
+            duration_s=round(_time.perf_counter() - t_quorum, 4),
+        )
 
         if quorum.quorum_id != self._quorum_id:
             # epoch-scoped rendezvous namespace on the primary's store
@@ -393,6 +428,8 @@ class Manager:
                     list(quorum.participant_ids),
                 )
             self._quorum_id = quorum.quorum_id
+            telemetry.QUORUM_RECONFIGURES.inc()
+            self.step_timer.mark_quorum()
             # fresh epoch: the flush request (if any) has been honored
             self._commit_failures = 0
             if self._rank == 0:
@@ -409,8 +446,17 @@ class Manager:
                     state_dict=self._manager_state_dict(),
                     timeout=self._timeout,
                 )
+                telemetry.HEALS_TOTAL.labels(role="send").inc(
+                    len(quorum.recover_dst_ranks)
+                )
             if quorum.heal:
                 self._healing = True
+                t_heal = _time.perf_counter()
+                telemetry.emit(
+                    "heal_begin",
+                    step=quorum.max_step,
+                    src=quorum.recover_src_manager_address,
+                )
                 self._logger.info(
                     f"healing: fetching checkpoint metadata from "
                     f"{quorum.recover_src_manager_address} at step {quorum.max_step}"
@@ -446,6 +492,21 @@ class Manager:
                 # load_state_dict above already restores it, but being
                 # explicit keeps the invariant obvious
                 self._step = quorum.max_step
+                heal_s = _time.perf_counter() - t_heal
+                nbytes = getattr(
+                    self._checkpoint_transport, "last_recv_bytes", 0
+                )
+                if not isinstance(nbytes, int):  # un-instrumented transport
+                    nbytes = 0
+                telemetry.HEALS_TOTAL.labels(role="recv").inc()
+                telemetry.HEAL_DURATION.observe(heal_s)
+                self.step_timer.mark_heal()
+                telemetry.emit(
+                    "heal_end",
+                    step=quorum.max_step,
+                    bytes=nbytes,
+                    duration_s=round(heal_s, 4),
+                )
 
     def _sweep_stale_epochs(self, current_qid: int) -> None:
         """GC rendezvous keys from dead epochs (round-2 verdict weak #5).
@@ -689,6 +750,14 @@ class Manager:
         if victim in self._evicted:
             return
         self._evicted.add(victim)
+        # the trail's detection record lives HERE, not in the death-watch
+        # callback: a dead peer can also surface as a PeerGoneError from a
+        # failed collective/p2p op (report_error path) without the poll
+        # thread ever firing — both roads converge on this dedup point
+        telemetry.PEER_DEATHS.inc()
+        telemetry.emit(
+            "peer_death", ring_rank=peer, replica=victim, step=self._step
+        )
 
         def _report() -> None:
             # Fresh client: self._client serializes calls on one socket, so
@@ -702,10 +771,15 @@ class Manager:
                     evicted = client.evict(victim, timeout=timedelta(seconds=5))
                 finally:
                     client.close()
+                telemetry.EVICTIONS_REPORTED.labels(
+                    result="evicted" if evicted else "rejected"
+                ).inc()
+                telemetry.emit("eviction", victim=victim, evicted=evicted)
                 self._logger.info(
                     f"reported dead peer {victim}: evicted={evicted}"
                 )
             except Exception as ex:  # noqa: BLE001 — best effort
+                telemetry.EVICTIONS_REPORTED.labels(result="failed").inc()
                 self._logger.warn(f"evict report for {victim} failed: {ex}")
 
         threading.Thread(target=_report, daemon=True, name="tft_evict").start()
@@ -746,6 +820,9 @@ class Manager:
         assert (
             self._quorum_future is not None
         ), "must call start_quorum before should_commit"
+        import time as _time
+
+        t_commit = _time.perf_counter()
         for work in self._pending_work:
             if self._errored is not None:
                 break
@@ -780,6 +857,7 @@ class Manager:
             local_should_commit,
             timeout=timeout or self._timeout,
         )
+        telemetry.COMMIT_BARRIER.observe(_time.perf_counter() - t_commit)
         self._logger.info(
             f"should_commit={should_commit} enough_replicas={enough_replicas} "
             f"errored={self._errored}"
@@ -796,9 +874,39 @@ class Manager:
             # death-watch re-quorum already rebuilt connectivity
             self._commit_failures += 1
 
+        # trail step number is the step that ran (pre-increment) — every
+        # lifecycle record of one step (quorum_start, commit/abort,
+        # step_outlier) joins on the same step value
+        step_in_trail = self._step
         if should_commit:
+            telemetry.COMMITS_TOTAL.labels(outcome="committed").inc()
+            telemetry.emit(
+                "commit", step=step_in_trail, participants=n_step
+            )
             self._step += 1
             self._batches_committed += n_step
+            telemetry.CURRENT_STEP.set(self._step)
+        else:
+            telemetry.COMMITS_TOTAL.labels(outcome="aborted").inc()
+            telemetry.emit(
+                "abort",
+                step=self._step,
+                enough_replicas=enough_replicas,
+                mixed_epochs=mixed_epochs,
+                errored=str(self._errored) if self._errored else None,
+            )
+        # step boundary for the rolling rate: quorum-reconfigure/heal steps
+        # are tagged as outliers, so the recovery cost of an FT event is
+        # readable from the trail instead of denting the headline rate
+        dur = self.step_timer.tick()
+        if dur is not None and self.step_timer.last_tags:
+            telemetry.emit(
+                "step_outlier",
+                step=step_in_trail,
+                duration_s=round(dur, 4),
+                tags=list(self.step_timer.last_tags),
+                committed=should_commit,
+            )
         return should_commit
 
     # ------------------------------------------------------------------

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+import torchft_tpu.utils.jax_compat  # noqa: F401 — polyfills older jax
+
 from torchft_tpu.ops.attention import (
     attention,
     chunked_attention,

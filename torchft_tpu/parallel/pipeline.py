@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+import torchft_tpu.utils.jax_compat  # noqa: F401 — polyfills older jax
+
 __all__ = ["pipeline_forward"]
 
 

@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+import torchft_tpu.utils.jax_compat  # noqa: F401 — polyfills older jax
+
 from torchft_tpu.models.transformer import (
     TransformerConfig,
     init_params,

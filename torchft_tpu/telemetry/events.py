@@ -1,0 +1,163 @@
+"""Structured FT event trail — the flight recorder for fault-tolerance
+lifecycle events.
+
+Every quorum formation, heal, peer death, eviction and commit/abort is an
+append-only JSONL record, so a recovery incident can be reconstructed from
+disk (event ordering + wall-clock deltas) instead of re-run under a
+profiler. The trail is process-wide: configure a sink once (or export
+``TORCHFT_EVENT_TRAIL=/path/trail.jsonl`` before the process starts) and
+every instrumented layer — Manager, collectives, checkpoint transports —
+appends to it. An in-memory ring buffer always records the most recent
+events regardless of sink, so tests and ``telemetry.dump()`` can read the
+trail without touching the filesystem.
+
+Record schema (one JSON object per line)::
+
+    {"ts": <unix seconds, float>, "event": "<kind>", ...fields}
+
+Canonical kinds and their fields are documented in
+``docs/observability.md`` (quorum_start, quorum_ready, heal_begin,
+heal_end, peer_death, eviction, commit, abort, checkpoint_send,
+checkpoint_recv, step_outlier).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+__all__ = ["EventTrail", "read_trail"]
+
+ENV_TRAIL_PATH = "TORCHFT_EVENT_TRAIL"
+
+
+class EventTrail:
+    """Thread-safe JSONL event sink with an in-memory ring buffer."""
+
+    def __init__(self, path: Optional[str] = None, maxlen: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self._ring: Deque[Dict[str, Any]] = deque(maxlen=maxlen)
+        self._file: Optional[io.TextIOBase] = None
+        self._path: Optional[str] = None
+        self._env_checked = False
+        if path:
+            self.configure(path)
+
+    # -- sink management --
+
+    def configure(self, path: Optional[str]) -> None:
+        """Point the trail at ``path`` (append mode), or detach with None.
+        Replaces any previous sink."""
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+            self._path = path
+            self._env_checked = True  # explicit config wins over env
+            if path:
+                d = os.path.dirname(path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._file = open(path, "a", encoding="utf-8")
+
+    def path(self) -> Optional[str]:
+        with self._lock:
+            return self._path
+
+    def _maybe_open_from_env(self) -> None:
+        # called under self._lock
+        if self._env_checked:
+            return
+        self._env_checked = True
+        path = os.environ.get(ENV_TRAIL_PATH)
+        if not path:
+            return
+        try:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._file = open(path, "a", encoding="utf-8")
+            self._path = path
+        except OSError:
+            # observability must never take down training
+            self._file = None
+            self._path = None
+
+    # -- producer side --
+
+    def emit(self, event: str, **fields: Any) -> Dict[str, Any]:
+        """Append one record; returns it (with the stamped ``ts``)."""
+        record = {"ts": time.time(), "event": event, **fields}
+        line: Optional[str] = None
+        with self._lock:
+            self._maybe_open_from_env()
+            self._ring.append(record)
+            if self._file is not None:
+                try:
+                    line = json.dumps(record, default=str)
+                    self._file.write(line + "\n")
+                    self._file.flush()
+                except (OSError, ValueError):
+                    pass  # a full disk must not fail a step
+        # metric alongside the trail so dashboards can rate() FT events
+        # without parsing JSONL (late import avoids a module cycle)
+        from torchft_tpu.telemetry import FT_EVENTS_TOTAL
+
+        FT_EVENTS_TOTAL.labels(event=event).inc()
+        return record
+
+    # -- consumer side --
+
+    def recent(
+        self, event: Optional[str] = None, limit: Optional[int] = None
+    ) -> List[Dict[str, Any]]:
+        """Most recent records from the ring buffer, oldest first;
+        optionally filtered to one event kind."""
+        with self._lock:
+            records = list(self._ring)
+        if event is not None:
+            records = [r for r in records if r.get("event") == event]
+        if limit is not None:
+            records = records[-limit:]
+        return records
+
+    def clear(self) -> None:
+        """Empty the ring buffer (the file sink, if any, is untouched)."""
+        with self._lock:
+            self._ring.clear()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+
+def read_trail(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL trail file back into records (skipping torn tails —
+    a SIGKILLed process may leave a partial last line)."""
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    continue
+    except FileNotFoundError:
+        pass
+    return records

@@ -1,0 +1,54 @@
+"""Bridge to the C++ coordination layer's built-in counters.
+
+The native lighthouse (native/coord.cc) already serves ``/status.json``
+and a Prometheus ``/metrics`` page on its dashboard port — quorum_id,
+participant steps, evictions_total, flush_requests_total, heartbeat ages.
+Those counters live in the C++ process (possibly a different box), so the
+Python registry can't own them; instead this module polls them over the
+existing HTTP surface and either returns them as a dict
+(:func:`poll_lighthouse`) or splices the raw exposition text into this
+process's scrape output (:func:`scrape_lighthouse_metrics`), so one
+Prometheus target can carry both layers.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Dict, Optional
+
+__all__ = ["poll_lighthouse", "scrape_lighthouse_metrics"]
+
+
+def _base_url(addr: str) -> str:
+    # LighthouseServer.address() returns "http://host:port"; accept a bare
+    # host:port too (the TORCHFT_LIGHTHOUSE env convention).
+    if "://" not in addr:
+        addr = "http://" + addr
+    return addr.rstrip("/")
+
+
+def poll_lighthouse(addr: str, timeout: float = 2.0) -> Optional[Dict[str, Any]]:
+    """Fetch the lighthouse's ``/status.json`` native counters
+    (quorum_id, members + per-member step/plane, evictions_total,
+    flush_requests_total, recent evictions). Returns None when the
+    lighthouse is unreachable — observability must degrade, not raise."""
+    try:
+        with urllib.request.urlopen(
+            f"{_base_url(addr)}/status.json", timeout=timeout
+        ) as resp:
+            return json.loads(resp.read().decode())
+    except Exception:  # noqa: BLE001 — any failure means "no native stats"
+        return None
+
+
+def scrape_lighthouse_metrics(addr: str, timeout: float = 2.0) -> str:
+    """Fetch the lighthouse's raw Prometheus ``/metrics`` text (the
+    ``torchft_*`` family). Empty string when unreachable."""
+    try:
+        with urllib.request.urlopen(
+            f"{_base_url(addr)}/metrics", timeout=timeout
+        ) as resp:
+            return resp.read().decode()
+    except Exception:  # noqa: BLE001
+        return ""

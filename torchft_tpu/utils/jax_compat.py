@@ -1,0 +1,131 @@
+"""Polyfills for older JAX runtimes.
+
+The codebase targets the current stable JAX surface — ``jax.shard_map``,
+``jax.set_mesh``, ``jax.sharding.get_abstract_mesh`` — but deployment
+containers may ship an older 0.4.x wheel where shard_map still lives in
+``jax.experimental`` (with a ``mesh``-required, ``auto``-complement
+signature), ``set_mesh`` does not exist (the ``Mesh`` object itself is the
+context manager) and there is no ``get_abstract_mesh`` (the ambient mesh
+lives in the thread resource env).
+
+Importing this module installs the missing attributes ONTO the jax
+namespace (only when absent — a current JAX is untouched), so both library
+code and tests can use the one modern spelling. Every jax-adjacent module
+in the package imports it, which also covers subprocess entry points that
+bypass tests/conftest.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = ["install", "enable_cpu_gloo_collectives"]
+
+
+def enable_cpu_gloo_collectives() -> None:
+    """Pick the gloo cross-process collectives backend for CPU
+    multi-controller runtimes. On older jax the default is 'none' and the
+    first computation spanning processes dies with "Multiprocess
+    computations aren't implemented on the CPU backend"; newer jax
+    defaults to gloo, where this is a no-op. Call before
+    ``jax.distributed.initialize``. Only acts when JAX_PLATFORMS pins
+    cpu — on real accelerators the platform's own collectives rule."""
+    import os
+
+    if not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass  # option renamed/removed: the runtime default must do
+
+
+def _context_mesh():
+    """The ambient physical mesh of the old resource env, or None.
+
+    Returns None inside a shard_map manual region: callers use this to
+    gate ``with_sharding_constraint`` (modern jax keeps non-manual axes
+    constrainable there, but the 0.4.x partitioner cannot — the constraint
+    must be dropped, which is safe: the ex-auto axes are replicated inside
+    translated regions, see ``shard_map`` below)."""
+    from jax._src import core, mesh as mesh_lib
+
+    if core.nonempty_axis_env():
+        return None
+    m = mesh_lib.thread_resources.env.physical_mesh
+    return None if m is None or m.empty else m
+
+
+def install() -> None:
+    """Idempotently polyfill the modern API onto an old jax namespace."""
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(
+            f,
+            mesh=None,
+            in_specs=None,
+            out_specs=None,
+            axis_names=None,
+            check_vma=None,
+            **kw,
+        ):
+            """Modern jax.shard_map surface over the 0.4.x experimental
+            one. Mesh defaults to the ambient context mesh. A PARTIAL
+            manual region (``axis_names`` ⊂ mesh axes) is translated to a
+            FULL-manual one: 0.4.x partial-auto is broken (axis_index
+            lowers to a PartitionId the SPMD partitioner rejects; scan +
+            ppermute under auto axes trips a partitioner CHECK). Specs
+            leave the ex-auto axes unmentioned, so inputs arrive
+            replicated over them (GSPMD gathers at the region boundary)
+            and outputs return replicated — semantics preserved at some
+            gather/compute redundancy, which is acceptable on the old
+            runtime. Replication of ex-auto axes cannot be certified by
+            the 0.4.x rep checker, so it is disabled for translated
+            regions. ``check_vma`` maps to ``check_rep``."""
+            if mesh is None:
+                mesh = _context_mesh()
+                if mesh is None:
+                    raise ValueError(
+                        "shard_map: no mesh argument and no context mesh "
+                        "(enter one with jax.set_mesh(mesh))"
+                    )
+            if check_vma is not None:
+                kw.setdefault("check_rep", bool(check_vma))
+            if axis_names is not None and frozenset(axis_names) != frozenset(
+                mesh.axis_names
+            ):
+                kw["check_rep"] = False
+            return _shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+            )
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax, "set_mesh"):
+
+        @contextlib.contextmanager
+        def set_mesh(mesh):
+            # the Mesh object is its own context manager in 0.4.x; it
+            # installs the resource env that with_sharding_constraint and
+            # context-mesh shard_map read
+            with mesh:
+                yield mesh
+
+        jax.set_mesh = set_mesh
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = _context_mesh
+
+    if not hasattr(jax.lax, "pcast"):
+        def pcast(x, axes, to=None):  # noqa: ARG001 — modern signature
+            # 0.4.x has no varying-manual-axes type system, so casting a
+            # value's VMA set is the identity
+            return x
+
+        jax.lax.pcast = pcast
+
+
+install()
