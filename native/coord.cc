@@ -391,11 +391,52 @@ Value Lighthouse::handle_rpc(const std::string& method, const Value& req,
   if (method == "lh.quorum") return handle_quorum(req, deadline);
   if (method == "lh.heartbeat") {
     std::lock_guard<std::mutex> g(mu_);
-    state_.heartbeats[req.gets("replica_id")] = now_ms();
+    const std::string id = req.gets("replica_id");
+    state_.heartbeats[id] = now_ms();
+    if (req.has("telemetry")) ingest_telemetry(id, req.at("telemetry"));
     return Value::M();
   }
   if (method == "lh.evict") return handle_evict(req);
   throw RpcError(INVALID_ARGUMENT, "unknown method " + method);
+}
+
+void Lighthouse::ingest_telemetry(const std::string& replica_id,
+                                  const Value& v) {
+  // Stores are verbatim: summary is an opaque JSON object string, spans are
+  // raw Chrome trace-event fragments (comma-joined objects, no brackets).
+  // Caps bound memory per replica and across replicas — telemetry from a
+  // chatty or malicious report must never OOM the coordinator.
+  static constexpr size_t kMaxSpanBytesPerReplica = 1 << 20;  // 1 MiB
+  static constexpr size_t kMaxBatchesPerReplica = 64;
+  static constexpr size_t kMaxReplicas = 256;
+  if (v.type != Value::Type::MAP) return;
+  if (telemetry_.count(replica_id) == 0 && telemetry_.size() >= kMaxReplicas) {
+    // evict the stalest entry (dead uuids from respawned groups)
+    auto oldest = telemetry_.begin();
+    for (auto it = telemetry_.begin(); it != telemetry_.end(); ++it)
+      if (it->second.last_ms < oldest->second.last_ms) oldest = it;
+    telemetry_.erase(oldest);
+  }
+  ReplicaTelemetry& t = telemetry_[replica_id];
+  t.last_ms = now_ms();  // monotonic, same clock as heartbeats
+  if (v.has("step")) t.step = v.geti("step", t.step);
+  if (v.has("stuck")) t.stuck = v.getb("stuck", false);
+  if (v.has("last_heal_ts")) t.last_heal_ts = v.at("last_heal_ts").f;
+  std::string summary = v.gets("summary");
+  // minimal validation: the summary is spliced raw into /cluster.json, so
+  // only accept something that at least looks like a JSON object
+  if (!summary.empty() && summary.front() == '{' && summary.back() == '}')
+    t.summary_json = std::move(summary);
+  std::string spans = v.gets("spans");
+  if (!spans.empty() && spans.size() <= kMaxSpanBytesPerReplica) {
+    t.span_batches.push_back(std::move(spans));
+    t.span_bytes += t.span_batches.back().size();
+    while (t.span_batches.size() > kMaxBatchesPerReplica ||
+           t.span_bytes > kMaxSpanBytesPerReplica) {
+      t.span_bytes -= t.span_batches.front().size();
+      t.span_batches.erase(t.span_batches.begin());
+    }
+  }
 }
 
 Value Lighthouse::handle_evict(const Value& req) {
@@ -485,6 +526,8 @@ Value Lighthouse::handle_quorum(const Value& req, int64_t deadline) {
   state_.heartbeats[requester.replica_id] = now_ms();
   state_.participants[requester.replica_id] =
       MemberDetails{now_ms(), requester};
+  if (req.has("telemetry"))
+    ingest_telemetry(requester.replica_id, req.at("telemetry"));
   uint64_t seen = quorum_seq_;
   // Proactive tick so a fast quorum resolves without waiting a full tick
   // (src/lighthouse.rs:470-473).
@@ -619,6 +662,32 @@ std::string Lighthouse::status_html() {
       << "s</td></tr>";
   }
   o << "</table>";
+  if (!telemetry_.empty()) {
+    // Per-replica health: the operator triage table. last_seen is the
+    // telemetry report age (reports ride quorum traffic, so a healthy
+    // training loop refreshes it every step).
+    o << "<h2>Replica health</h2><table border=1 cellpadding=4>"
+         "<tr><th>replica_id</th><th>last report</th><th>step</th>"
+         "<th>last heal</th><th>stuck</th></tr>";
+    // two clocks on purpose: report ages use the monotonic clock that
+    // stamped last_ms (mixing in wall time would show epoch-offset
+    // garbage), while last_heal_ts is a unix timestamp from the replica
+    // and must be compared against wall time
+    int64_t mono_now = now_ms();
+    double wall_now_s = wall_ms() / 1000.0;
+    for (const auto& [id, t] : telemetry_) {
+      o << "<tr" << (t.stuck ? " style=\"background:red\"" : "") << "><td>"
+        << html_escape(id) << "</td><td>" << (mono_now - t.last_ms) / 1000.0
+        << "s ago</td><td>" << t.step << "</td><td>";
+      if (t.last_heal_ts > 0)
+        o << (wall_now_s - t.last_heal_ts) << "s ago";
+      else
+        o << "never";
+      o << "</td><td>" << (t.stuck ? "STUCK" : "ok") << "</td></tr>";
+    }
+    o << "</table><p><a href=\"/cluster.json\">cluster.json</a> | "
+         "<a href=\"/trace\">merged trace (open in Perfetto)</a></p>";
+  }
   o << "<h2>FT events</h2><p>evictions: " << evictions_total_
     << " | data-plane flush re-quorums: " << flush_requests_total_ << "</p>";
   if (!recent_evictions_.empty()) {
@@ -629,6 +698,63 @@ std::string Lighthouse::status_html() {
       o << "<tr><td>" << html_escape(*it) << "</td></tr>";
     o << "</table>";
   }
+  return o.str();
+}
+
+std::string Lighthouse::cluster_json() {
+  // One page answering "which replica stalled, in which state, during
+  // which epoch": per-replica last report age, step, heal recency, stuck
+  // flag, and the replica's own counters digest (spliced verbatim — it is
+  // already a JSON object produced by telemetry.summary()).
+  std::unique_lock<std::mutex> lk(mu_);
+  int64_t now = now_ms();  // monotonic: ages only, never absolute times
+  std::ostringstream o;
+  o << "{\"now_unix_ms\":" << wall_ms() << ",\"quorum_id\":"
+    << state_.quorum_id << ",\"replicas\":{";
+  bool first = true;
+  for (const auto& [id, t] : telemetry_) {
+    if (!first) o << ",";
+    first = false;
+    // fixed-point: default ostream precision would render real unix
+    // timestamps in scientific notation with ~1000 s of rounding error
+    char heal_ts[32];
+    snprintf(heal_ts, sizeof heal_ts, "%.3f", t.last_heal_ts);
+    o << "\"" << json_escape(id) << "\":{\"last_seen_ms_ago\":"
+      << (now - t.last_ms) << ",\"step\":" << t.step
+      << ",\"stuck\":" << (t.stuck ? "true" : "false")
+      << ",\"last_heal_ts\":" << heal_ts << ",\"summary\":"
+      << (t.summary_json.empty() ? "{}" : t.summary_json)
+      << ",\"heartbeat_ms_ago\":";
+    auto hb = state_.heartbeats.find(id);
+    if (hb != state_.heartbeats.end())
+      o << (now - hb->second);
+    else
+      o << "null";
+    o << "}";
+  }
+  o << "}}";
+  return o.str();
+}
+
+std::string Lighthouse::merged_trace_json() {
+  // Chrome trace-event JSON merging every replica's piggybacked span
+  // batches onto one timeline. Batches are comma-joined fragments of
+  // already-serialized trace events (tracing.py drain_chrome_fragment),
+  // so the merge is pure concatenation — the C++ core never parses spans.
+  std::unique_lock<std::mutex> lk(mu_);
+  std::ostringstream o;
+  o << "{\"displayTimeUnit\":\"ms\",\"traceEvents\":[";
+  bool first = true;
+  for (const auto& [id, t] : telemetry_) {
+    (void)id;
+    for (const auto& frag : t.span_batches) {
+      if (frag.empty()) continue;
+      if (!first) o << ",";
+      first = false;
+      o << frag;
+    }
+  }
+  o << "]}";
   return o.str();
 }
 
@@ -644,6 +770,10 @@ std::string Lighthouse::handle_http(const std::string& method,
         "t();setInterval(t,1000);</script></body></html>");
   }
   if (method == "GET" && path == "/status") return http_ok(status_html());
+  if (method == "GET" && path == "/cluster.json")
+    return http_ok(cluster_json(), "application/json");
+  if (method == "GET" && path == "/trace")
+    return http_ok(merged_trace_json(), "application/json");
   if (method == "GET" && path == "/metrics") {
     // Prometheus text exposition — observability the reference lacks
     // (SURVEY §5.5: "No metrics export"). Scrape-friendly names under a
@@ -881,6 +1011,19 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
   pending_commit_failures_ =
       std::max(pending_commit_failures_, req.geti("commit_failures", 0));
   if (req.has("plane")) pending_plane_ = req.gets("plane");
+  if (req.has("telemetry") && req.at("telemetry").type == Value::Type::MAP) {
+    // Scalars: last-writer-wins across this round's local ranks. Span
+    // fragments: concatenated, so no rank's spans are dropped.
+    const Value& t = req.at("telemetry");
+    std::string spans = t.gets("spans");
+    // cap: repeated failed quorum rounds must not accumulate fragments
+    // without bound (they are re-attempted until the lighthouse answers)
+    if (!spans.empty() && pending_spans_.size() + spans.size() < (1u << 20)) {
+      if (!pending_spans_.empty()) pending_spans_ += ",";
+      pending_spans_ += spans;
+    }
+    pending_telemetry_ = t;
+  }
   uint64_t seen = quorum_seq_;
 
   if (participants_.size() >= world_size_) {
@@ -898,6 +1041,13 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
     pending_commit_failures_ = 0;
     Value lreq = Value::M();
     lreq.set("requester", me.to_value());
+    if (!pending_telemetry_.is_none()) {
+      Value t = pending_telemetry_;
+      if (!pending_spans_.empty()) t.set("spans", Value::S(pending_spans_));
+      lreq.set("telemetry", t);
+      pending_telemetry_ = Value::None();
+      pending_spans_.clear();
+    }
     // Like the reference (src/manager.rs:181 TODO), the lock is held for the
     // duration of the lighthouse call; peer handlers are parked in cv waits.
     try {

@@ -114,6 +114,21 @@ struct LighthouseState {
   int64_t quorum_id = 0;
 };
 
+// Per-replica telemetry snapshot, piggybacked by replicas on their quorum
+// (and optionally heartbeat) traffic. The lighthouse stores it verbatim —
+// the summary is an opaque JSON object and the span batches are raw Chrome
+// trace-event fragments — so the Python telemetry schema can evolve
+// without touching the C++ core.
+struct ReplicaTelemetry {
+  int64_t last_ms = 0;      // wall-clock ms of the last report
+  int64_t step = -1;        // replica's committed step at report time
+  bool stuck = false;       // step watchdog latched a stall
+  double last_heal_ts = 0;  // unix seconds of the last heal (0 = never)
+  std::string summary_json; // compact counters digest (JSON object)
+  std::vector<std::string> span_batches;  // chrome trace-event fragments
+  size_t span_bytes = 0;    // bytes across span_batches (for the cap)
+};
+
 // Returns (members or nullopt, human-readable reason).
 // Mirrors quorum_compute (src/lighthouse.rs:113-241): healthy-filter by
 // heartbeat age, shrink_only candidate filtering, fast quorum when all prev
@@ -152,7 +167,11 @@ class Lighthouse {
   void tick_loop();
   // Must hold mu_. Runs one quorum evaluation and publishes if met.
   void quorum_tick();
+  // Must hold mu_. Stores one replica's piggybacked telemetry report.
+  void ingest_telemetry(const std::string& replica_id, const Value& v);
   std::string status_html();
+  std::string cluster_json();
+  std::string merged_trace_json();
   static std::string http_error_page(const std::string& msg);
 
   LighthouseOpt opt_;
@@ -169,6 +188,9 @@ class Lighthouse {
   int64_t evictions_total_ = 0;
   int64_t flush_requests_total_ = 0;
   std::vector<std::string> recent_evictions_;  // "victim < reporter @ unix_s"
+  // Cluster telemetry aggregation (PR 2): per-replica rolling store fed by
+  // piggybacked reports, served at /cluster.json and merged at /trace.
+  std::map<std::string, ReplicaTelemetry> telemetry_;
 
   std::atomic<bool> running_{true};
   std::thread tick_thread_;
@@ -212,6 +234,10 @@ class ManagerSrv {
   std::set<int64_t> participants_;
   int64_t pending_commit_failures_ = 0;  // max over this round's ranks
   std::string pending_plane_;  // last plane reported by a local rank
+  // Telemetry piggyback: latest per-rank report this round; span
+  // fragments are concatenated across ranks, scalars last-write-wins.
+  Value pending_telemetry_;    // NONE when nothing to forward
+  std::string pending_spans_;  // accumulated chrome fragments this round
   uint64_t quorum_seq_ = 0;
   std::map<uint64_t, Quorum> quorums_;  // seq -> delivered quorum
   std::optional<std::string> quorum_error_;  // lighthouse failure fan-out

@@ -15,7 +15,7 @@ from torchft_tpu.store import StoreServer
 
 # multi-process soak tier: excluded from the default run (pyproject
 # addopts); execute with `pytest -m soak`
-from conftest import scaled_timeout
+from conftest import scaled_timeout, skip_if_known_corruption
 
 pytestmark = pytest.mark.soak
 
@@ -41,7 +41,7 @@ def _run_groups(script: str, num_groups: int, extra_env: dict, min_replicas=None
                 JAX_PLATFORMS="cpu",
             )
             env.update(extra_env)
-            proc = subprocess.run(
+            return subprocess.run(
                 [sys.executable, os.path.join(REPO, "examples", script)],
                 env=env,
                 capture_output=True,
@@ -49,11 +49,24 @@ def _run_groups(script: str, num_groups: int, extra_env: dict, min_replicas=None
                 timeout=scaled_timeout(240),
                 cwd=REPO,
             )
-            assert proc.returncode == 0, proc.stderr[-3000:]
-            return proc.stderr + proc.stdout
 
         with ThreadPoolExecutor(max_workers=num_groups) as pool:
-            return list(pool.map(run, range(num_groups)))
+            procs = list(pool.map(run, range(num_groups)))
+        if any(p.returncode != 0 for p in procs):
+            # Gather ALL workers before judging: one worker dying of the
+            # documented pre-existing native corruption (ROADMAP open
+            # item) cascades into quorum timeouts on its peers, and only
+            # the ROOT death carries the interesting evidence — shared
+            # policy in conftest.skip_if_known_corruption.
+            skip_if_known_corruption(
+                "".join(p.stderr for p in procs),
+                rcs=[p.returncode for p in procs],
+            )
+            bad = next(p for p in procs if p.returncode != 0)
+            raise AssertionError(
+                f"worker rc={bad.returncode}: {bad.stderr[-3000:]}"
+            )
+        return [p.stderr + p.stdout for p in procs]
     finally:
         for s in stores:
             s.shutdown()

@@ -5,6 +5,7 @@ asserting quorum/commit events fire.
 """
 
 import json
+import os
 import re
 import threading
 import time
@@ -363,8 +364,39 @@ def test_manager_2replica_quorum_commit_events():
                 for g in range(2)
             ]
             results = [f.result(timeout=120) for f in futs]
+        # while the lighthouse is still up: the cluster aggregation must
+        # have received each replica's piggybacked telemetry (rides the
+        # quorum traffic — no extra RPCs to trigger here)
+        from torchft_tpu.telemetry.native import fetch_merged_trace, poll_cluster
+
+        cluster = poll_cluster(lh.address())
+        trace = fetch_merged_trace(lh.address())
     finally:
         lh.shutdown()
+
+    assert cluster is not None
+    groups = [
+        rid for rid in cluster["replicas"] if rid.startswith("telemetry_g")
+    ]
+    assert len(groups) == 2, cluster
+    for rid in groups:
+        assert cluster["replicas"][rid]["step"] >= 0
+        assert "quorums" in cluster["replicas"][rid]["summary"]
+
+    # merged Chrome trace carries spans from BOTH replicas, and their
+    # trace ids correlate on quorum epoch (trace_id = replica:step:epoch)
+    assert trace is not None
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    epochs_by_replica = {}
+    for e in xs:
+        tid = e.get("args", {}).get("trace_id", "")
+        rid, _, rest = tid.partition(":")
+        _, _, epoch = rest.partition(":")
+        if rid.startswith("telemetry_g"):
+            epochs_by_replica.setdefault(rid, set()).add(epoch)
+    assert len(epochs_by_replica) == 2, epochs_by_replica
+    e1, e2 = epochs_by_replica.values()
+    assert e1 & e2, f"no correlated quorum epoch: {epochs_by_replica}"
 
     assert all(r["committed"] == steps for r in results)
     # both groups averaged (1+2)/2 = 1.5 every step
@@ -489,3 +521,30 @@ def test_kill_one_replica_trail_records_death_then_heal():
     assert any("heal" in e.get("tags", ()) for e in victim_outliers), (
         victim_outliers
     )
+
+    # acceptance (PR 2): the kill/respawn run produced a merged Chrome
+    # trace at the lighthouse /trace endpoint with spans from BOTH
+    # replicas carrying correlated quorum epochs
+    assert r.merged_trace_path and os.path.exists(r.merged_trace_path)
+    with open(r.merged_trace_path) as f:
+        trace = json.load(f)
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    epochs_by_replica = {}
+    for e in xs:
+        tid = e.get("args", {}).get("trace_id", "")
+        rid, _, rest = tid.partition(":")
+        _, _, epoch = rest.partition(":")
+        if rid:
+            epochs_by_replica.setdefault(rid, set()).add(epoch)
+    assert len(epochs_by_replica) >= 2, epochs_by_replica
+    # some PAIR of replicas shares a quorum epoch (the pre-kill victim,
+    # the survivor and the respawned victim are three distinct ids — the
+    # dead id and its replacement never coexist in one epoch)
+    ids = list(epochs_by_replica)
+    assert any(
+        epochs_by_replica[a] & epochs_by_replica[b]
+        for i, a in enumerate(ids)
+        for b in ids[i + 1 :]
+    ), epochs_by_replica
+    # ... and the per-replica health snapshot reflects both groups
+    assert r.cluster and len(r.cluster["replicas"]) >= 2

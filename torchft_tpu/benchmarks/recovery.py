@@ -158,6 +158,11 @@ class RecoveryResult:
     # correlating trail records with the induced failure
     t_kill_unix: float = 0.0
     t_respawn_unix: float = 0.0
+    # PR 2: the lighthouse's cluster aggregation captured before teardown —
+    # the merged Chrome trace (all replicas, one timeline; open in
+    # Perfetto) and the /cluster.json per-replica health snapshot
+    merged_trace_path: Optional[str] = None
+    cluster: Optional[Dict] = None
 
     def as_dict(self) -> Dict[str, object]:
         out: Dict[str, object] = {
@@ -271,6 +276,8 @@ def measure_recovery(
         "TORCHFT_BENCH_STEPS": str(total_steps),
         "TORCHFT_BENCH_STEP_SLEEP": str(step_sleep),
         "TORCHFT_BENCH_OP_TIMEOUT": str(op_timeout),
+        # hang forensics land next to the trails (flight dumps per pid)
+        "TORCHFT_FLIGHT_DIR": tmp,
     }
     procs: List[Optional[subprocess.Popen]] = [None] * num_groups
     try:
@@ -350,6 +357,15 @@ def measure_recovery(
             for rec in read_trail(path):
                 kind = rec.get("event", "?")
                 ft_events[kind] = ft_events.get(kind, 0) + 1
+        # snapshot the cluster aggregation while the lighthouse is alive:
+        # the merged trace IS the incident timeline (kill -> eviction ->
+        # re-quorum -> heal) across every replica
+        from torchft_tpu.telemetry.native import fetch_merged_trace, poll_cluster
+
+        merged_trace_path = os.path.join(tmp, "cluster_trace.json")
+        if fetch_merged_trace(lighthouse.address(), path=merged_trace_path) is None:
+            merged_trace_path = None
+        cluster = poll_cluster(lighthouse.address())
         return RecoveryResult(
             survivor_blackout_s=blackout,
             rejoin_to_commit_s=rejoin["t"] - t_respawn,
@@ -360,6 +376,8 @@ def measure_recovery(
             trail_paths=list(trails),
             t_kill_unix=t_kill,
             t_respawn_unix=t_respawn,
+            merged_trace_path=merged_trace_path,
+            cluster=cluster,
         )
     finally:
         for p in procs:

@@ -48,6 +48,24 @@ class _Server(ThreadingHTTPServer):
     daemon_threads = True
 
 
+TRACE_HEADER = "X-TFT-Trace"
+
+
+def _traced_urlopen(url: str, timeout: float):
+    """urlopen with the caller's trace context attached, so the serving
+    side records its span as a child of the requesting replica's span —
+    the cross-replica parent/child link on the merged timeline."""
+    req = urllib.request.Request(url)
+    try:
+        req.add_header(
+            TRACE_HEADER,
+            telemetry.TRACER.format_carrier(telemetry.TRACER.inject()),
+        )
+    except Exception:  # noqa: BLE001 — tracing must never fail a transfer
+        pass
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
 def _assign_chunks(sizes: List[int], num_chunks: int) -> List[List[int]]:
     """Greedy size-balanced assignment of buffer indices to chunks."""
     order = sorted(range(len(sizes)), key=lambda i: -sizes[i])
@@ -152,8 +170,25 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
                     self.send_header("Content-Length", str(nbytes))
                     self.end_headers()
                     t0 = time.perf_counter()
-                    for part in payload:
-                        self.wfile.write(part)
+                    # child span of the healing replica's heal_recv span:
+                    # the requester ships its trace context in a header
+                    carrier = telemetry.TRACER.parse_carrier(
+                        self.headers.get(TRACE_HEADER, "") or ""
+                    )
+                    with telemetry.TRACER.span(
+                        "checkpoint_serve",
+                        parent=carrier,
+                        # our own identity, not the carrier's: the span
+                        # joins the HEALER's trace (parent/trace_id) but
+                        # must render on the SERVING replica's lane
+                        replica_id=(
+                            telemetry.TRACER.context()["replica_id"] or None
+                        ),
+                        path=self.path,
+                        bytes=nbytes,
+                    ):
+                        for part in payload:
+                            self.wfile.write(part)
                     telemetry.record_checkpoint(
                         "send", nbytes, time.perf_counter() - t0, "http"
                     )
@@ -235,7 +270,7 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
 
     def _fetch_full(self, base: str, secs: float, step: int) -> T:
         t0 = time.perf_counter()
-        with urllib.request.urlopen(f"{base}/full", timeout=secs) as resp:
+        with _traced_urlopen(f"{base}/full", timeout=secs) as resp:
             from torchft_tpu.checkpointing.serialization import load_state
 
             state = load_state(resp)
@@ -265,7 +300,7 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
         import pickle
 
         t0 = time.perf_counter()
-        with urllib.request.urlopen(f"{base}/metadata", timeout=secs) as resp:
+        with _traced_urlopen(f"{base}/metadata", timeout=secs) as resp:
             header, groups = pickle.loads(resp.read())
         if not groups:
             # sender staged unchunked (its num_chunks=0 wins over ours)
@@ -277,7 +312,7 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
         buffers: List[Optional[np.ndarray]] = [None] * len(sizes)
 
         def fetch(ci: int) -> None:
-            with urllib.request.urlopen(f"{base}/chunk_{ci}", timeout=secs) as r:
+            with _traced_urlopen(f"{base}/chunk_{ci}", timeout=secs) as r:
                 for j in groups[ci]:
                     nbytes = sizes[j]
                     raw = r.read(nbytes)

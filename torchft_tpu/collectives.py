@@ -1066,22 +1066,35 @@ class CollectivesTcp(Collectives):
         self._op_seq = (self._op_seq + 1) & 0x00FFFFFF
         return self._op_seq
 
-    def _count_op(self, op_name: str) -> None:
+    def _count_op(self, op_name: str, nbytes: int = 0, tag: int = 0) -> int:
+        """Count the op and record its issue in the flight recorder;
+        returns the flight sequence id for completion marking."""
         from torchft_tpu import telemetry
 
-        telemetry.COLLECTIVE_OPS.labels(
-            op=op_name, plane=self.plane_info()
-        ).inc()
+        plane = self.plane_info()
+        telemetry.COLLECTIVE_OPS.labels(op=op_name, plane=plane).inc()
+        return telemetry.FLIGHT.record_issue(
+            op_name, plane, nbytes, tag=tag, rank=self._rank
+        )
+
+    def _track_flight(self, work: Work, fid: int) -> Work:
+        """Mark the flight record completed/failed when the op resolves."""
+        from torchft_tpu import telemetry
+
+        work.get_future().then(
+            lambda f: telemetry.FLIGHT.record_complete(fid, error=f.exception())
+        )
+        return work
 
     # -- collectives (all run on the op thread, SPMD-ordered) --
 
     def allreduce(self, arrays: List[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
         world, rank = self._world, self._rank
         tag = self._next_tag() | 0x01000000
+        nbytes = sum(int(a.nbytes) for a in arrays)
         # counted at submission like every other op (uniform semantics);
         # bytes + latency are recorded at completion in run()
-        self._count_op("allreduce")
-        nbytes = sum(int(a.nbytes) for a in arrays)
+        fid = self._count_op("allreduce", nbytes, tag)
 
         def run() -> List[np.ndarray]:
             import time
@@ -1106,7 +1119,7 @@ class CollectivesTcp(Collectives):
             )
             return arrays
 
-        return self._submit(run)
+        return self._track_flight(self._submit(run), fid)
 
     def _dp_eligible(self, arr: np.ndarray) -> bool:
         # wire_dtype other than bfloat16 isn't implemented natively; such
@@ -1202,7 +1215,7 @@ class CollectivesTcp(Collectives):
     def allgather(self, arr: np.ndarray) -> Work:
         world, rank = self._world, self._rank
         tag = self._next_tag() | 0x02000000
-        self._count_op("allgather")
+        fid = self._count_op("allgather", int(arr.nbytes), tag)
 
         def run() -> List[np.ndarray]:
             out: List[Optional[np.ndarray]] = [None] * world
@@ -1218,12 +1231,12 @@ class CollectivesTcp(Collectives):
                     out[cur_idx] = cur
             return out  # type: ignore[return-value]
 
-        return self._submit(run)
+        return self._track_flight(self._submit(run), fid)
 
     def broadcast(self, arr: np.ndarray, root: int = 0) -> Work:
         world, rank = self._world, self._rank
         tag = self._next_tag() | 0x03000000
-        self._count_op("broadcast")
+        fid = self._count_op("broadcast", int(arr.nbytes), tag)
 
         def run() -> np.ndarray:
             if world > 1:
@@ -1237,7 +1250,7 @@ class CollectivesTcp(Collectives):
                     _flat_view(arr)[:] = np.frombuffer(data, dtype=arr.dtype)
             return arr
 
-        return self._submit(run)
+        return self._track_flight(self._submit(run), fid)
 
     def reduce_scatter(
         self, arrays: List[np.ndarray], op: ReduceOp = ReduceOp.SUM
@@ -1246,7 +1259,9 @@ class CollectivesTcp(Collectives):
         if len(arrays) != world:
             raise ValueError(f"reduce_scatter needs {world} inputs, got {len(arrays)}")
         tag = self._next_tag() | 0x04000000
-        self._count_op("reduce_scatter")
+        fid = self._count_op(
+            "reduce_scatter", sum(int(a.nbytes) for a in arrays), tag
+        )
         reduce_fn = _REDUCE_FNS[op]
 
         def run() -> np.ndarray:
@@ -1275,14 +1290,16 @@ class CollectivesTcp(Collectives):
                 np.divide(acc, world, out=acc)
             return acc
 
-        return self._submit(run)
+        return self._track_flight(self._submit(run), fid)
 
     def alltoall(self, arrays: List[np.ndarray]) -> Work:
         world, rank = self._world, self._rank
         if len(arrays) != world:
             raise ValueError(f"alltoall needs {world} inputs, got {len(arrays)}")
         tag = self._next_tag() | 0x05000000
-        self._count_op("alltoall")
+        fid = self._count_op(
+            "alltoall", sum(int(a.nbytes) for a in arrays), tag
+        )
 
         def run() -> List[np.ndarray]:
             out: List[Optional[np.ndarray]] = [None] * world
@@ -1301,20 +1318,20 @@ class CollectivesTcp(Collectives):
                 )
             return out  # type: ignore[return-value]
 
-        return self._submit(run)
+        return self._track_flight(self._submit(run), fid)
 
     def send(self, arr: np.ndarray, dst: int, tag: int = 0) -> Work:
         wire_tag = 0x06000000 | (tag & 0xFFFFFF)
-        self._count_op("send")
+        fid = self._count_op("send", int(arr.nbytes), wire_tag)
 
         def run() -> None:
             self._send_to(dst, wire_tag, _bytes_view(arr))
 
-        return self._submit(run, p2p=True)
+        return self._track_flight(self._submit(run, p2p=True), fid)
 
     def recv(self, arr: np.ndarray, src: int, tag: int = 0) -> Work:
         wire_tag = 0x06000000 | (tag & 0xFFFFFF)
-        self._count_op("recv")
+        fid = self._count_op("recv", int(arr.nbytes), wire_tag)
 
         def run() -> np.ndarray:
             _flat_view(arr)  # contiguity check up front, like the old path
@@ -1322,19 +1339,19 @@ class CollectivesTcp(Collectives):
             assert done is None, "into-receive must fill in place"
             return arr
 
-        return self._submit(run, p2p=True)
+        return self._track_flight(self._submit(run, p2p=True), fid)
 
     def barrier(self) -> Work:
         token = np.zeros(1, dtype=np.int32)
         world = self._world
         tag = self._next_tag() | 0x07000000
-        self._count_op("barrier")
+        fid = self._count_op("barrier", 0, tag)
 
         def run() -> None:
             if world > 1:
                 self._ring_allreduce(token, ReduceOp.SUM, tag)
 
-        return self._submit(run)
+        return self._track_flight(self._submit(run), fid)
 
 
 # ---------------------------------------------------------------------------

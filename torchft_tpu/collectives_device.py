@@ -267,18 +267,28 @@ class CollectivesDevice(Collectives):
     def _rendezvous(self, kind: str, payload: Any, meta: Tuple = ()) -> Work:
         """Deposit this group's input for the next SPMD op slot; the last
         group to arrive computes and resolves everyone's future."""
+        from torchft_tpu import telemetry
+
         ep = self._epoch
         assert ep is not None, "configure() must be called first"
         if kind != "allreduce":  # allreduce accounts bytes+latency itself
-            from torchft_tpu import telemetry
-
             telemetry.COLLECTIVE_OPS.labels(op=kind, plane="device").inc()
         tag = self._next_tag()
+        nbytes = 0
+        try:
+            leaves = payload if isinstance(payload, list) else [payload]
+            nbytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in leaves)
+        except TypeError:
+            pass
+        fid = telemetry.FLIGHT.record_issue(
+            kind, "device", nbytes, tag=tag, rank=self._rank
+        )
         fut: Future = Future()
         run_op: Optional[_Op] = None
         with ep.lock:
             if ep.dead is not None:
                 fut.set_exception(ep.dead)
+                telemetry.FLIGHT.record_complete(fid, error=ep.dead)
                 return Work(future_timeout(fut, self._timeout))
             op = ep.ops.get(tag)
             if op is None:
@@ -292,6 +302,7 @@ class CollectivesDevice(Collectives):
                 # a desynced epoch can never make progress — fail everyone
                 # now instead of stranding the other groups' waiters
                 ep.fail_pending(exc)
+                telemetry.FLIGHT.record_complete(fid, error=exc)
                 raise exc
             op.inputs[self._rank] = payload
             op.futures[self._rank] = fut
@@ -300,7 +311,11 @@ class CollectivesDevice(Collectives):
                 run_op = op
         if run_op is not None:
             self._compute(run_op)
-        return Work(future_timeout(fut, self._timeout))
+        out = future_timeout(fut, self._timeout)
+        out.then(
+            lambda f: telemetry.FLIGHT.record_complete(fid, error=f.exception())
+        )
+        return Work(out)
 
     def _compute(self, op: _Op) -> None:
         try:

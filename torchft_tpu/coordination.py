@@ -174,30 +174,43 @@ class ManagerClient:
         timeout: timedelta,
         commit_failures: int = 0,
         plane: str = "",
+        telemetry_payload: Optional[Dict[str, Any]] = None,
     ) -> QuorumResult:
         """``commit_failures > 0`` requests a data-plane flush: the
         lighthouse bumps quorum_id even without membership change, forcing
         every group to re-rendezvous its collectives (extension beyond the
         reference, which needs a process restart for this). ``plane`` is
         this group's data-plane transport label, surfaced on the
-        lighthouse dashboard/metrics."""
+        lighthouse dashboard/metrics. ``telemetry_payload`` piggybacks a
+        compact per-replica telemetry summary (counters digest + recent
+        span batch) on this existing RPC; the manager server forwards it
+        to the lighthouse, which aggregates per replica for
+        ``GET /cluster.json`` and the merged ``GET /trace`` timeline —
+        zero extra control-plane round trips."""
         import time
 
         from torchft_tpu import telemetry
 
+        req: Dict[str, Any] = {
+            "rank": rank,
+            "step": step,
+            "checkpoint_metadata": checkpoint_metadata,
+            "shrink_only": shrink_only,
+            "commit_failures": commit_failures,
+            "plane": plane,
+            # trace context rides the RPC metadata. The C++ server does
+            # not consume it today (it keeps no spans) — it is there for
+            # wire-level debugging (a packet capture names the caller's
+            # span) and for future server-side correlation; the live
+            # cross-replica span linking is the checkpoint transport's
+            # X-TFT-Trace header plus the shared trace_id coordinates.
+            "trace": telemetry.TRACER.inject(),
+        }
+        if telemetry_payload:
+            req["telemetry"] = telemetry_payload
         t0 = time.perf_counter()
-        resp = self._client.call(
-            "mgr.quorum",
-            {
-                "rank": rank,
-                "step": step,
-                "checkpoint_metadata": checkpoint_metadata,
-                "shrink_only": shrink_only,
-                "commit_failures": commit_failures,
-                "plane": plane,
-            },
-            _ms(timeout),
-        )
+        with telemetry.TRACER.span("quorum_rpc", rank=rank, step=step):
+            resp = self._client.call("mgr.quorum", req, _ms(timeout))
         # the RPC long-polls until the lighthouse forms the quorum, so
         # this duration IS quorum-formation latency as this rank saw it
         telemetry.QUORUM_LATENCY.observe(time.perf_counter() - t0)
@@ -217,11 +230,21 @@ class ManagerClient:
         should_commit: bool,
         timeout: timedelta,
     ) -> bool:
-        resp = self._client.call(
-            "mgr.should_commit",
-            {"rank": rank, "step": step, "should_commit": should_commit},
-            _ms(timeout),
-        )
+        from torchft_tpu import telemetry
+
+        with telemetry.TRACER.span(
+            "should_commit_rpc", rank=rank, step=step, vote=should_commit
+        ):
+            resp = self._client.call(
+                "mgr.should_commit",
+                {
+                    "rank": rank,
+                    "step": step,
+                    "should_commit": should_commit,
+                    "trace": telemetry.TRACER.inject(),
+                },
+                _ms(timeout),
+            )
         return resp["should_commit"]
 
     def kill(self, msg: str = "", timeout: timedelta = timedelta(seconds=10)) -> None:
@@ -250,8 +273,19 @@ class LighthouseClient:
     def __init__(self, addr: str, connect_timeout: timedelta) -> None:
         self._client = _native.NativeClient(addr, _ms(connect_timeout))
 
-    def heartbeat(self, replica_id: str, timeout: timedelta = timedelta(seconds=5)) -> None:
-        self._client.call("lh.heartbeat", {"replica_id": replica_id}, _ms(timeout))
+    def heartbeat(
+        self,
+        replica_id: str,
+        timeout: timedelta = timedelta(seconds=5),
+        telemetry_payload: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Heartbeat; ``telemetry_payload`` optionally piggybacks a
+        per-replica telemetry summary for the lighthouse's cluster
+        aggregation (same shape the Manager sends on quorum traffic)."""
+        req: Dict[str, Any] = {"replica_id": replica_id}
+        if telemetry_payload:
+            req["telemetry"] = telemetry_payload
+        self._client.call("lh.heartbeat", req, _ms(timeout))
 
     def quorum(
         self,

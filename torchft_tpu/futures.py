@@ -172,6 +172,14 @@ class _TimeoutManager:
                 from torchft_tpu import telemetry
 
                 telemetry.FUTURE_TIMEOUTS.inc()
+                # a deadline on the FT data plane usually means a wedged
+                # collective: capture the per-rank op history NOW, while
+                # the evidence (last completed / first stuck op) is fresh.
+                # Rate-limited inside dump(); must never fail the timeout.
+                try:
+                    telemetry.FLIGHT.dump("deadline")
+                except Exception:  # noqa: BLE001
+                    pass
                 fut.set_exception(
                     TimeoutError("future did not complete within deadline")
                 )

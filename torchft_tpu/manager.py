@@ -194,10 +194,12 @@ class Manager:
         self._collectives = collectives
         self._manager: Optional[ManagerServer] = None
 
+        self._lighthouse_addr: Optional[str] = None
         if rank == 0:
             if port is None:
                 port = int(os.environ.get(MANAGER_PORT_ENV, 0))
             lighthouse_addr = lighthouse_addr or os.environ[LIGHTHOUSE_ENV]
+            self._lighthouse_addr = lighthouse_addr
             replica_id = (replica_id or "") + str(uuid.uuid4())
             self._manager = ManagerServer(
                 replica_id=replica_id,
@@ -217,6 +219,7 @@ class Manager:
         self._client = ManagerClient(addr, connect_timeout=connect_timeout)
         replica_id = self._store.get(REPLICA_ID_KEY).decode()
         self._logger = _ManagerLogger(self, replica_id or "", rank)
+        self._replica_id = replica_id or ""
 
         self._step = 0
         self._quorum_id = -1
@@ -249,6 +252,82 @@ class Manager:
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
 
+        # Hang forensics (PR 2): SIGUSR2 dumps the collective flight
+        # recorder (best-effort — only possible from the main thread), and
+        # the step watchdog turns a silently wedged step into a
+        # watchdog_stall event + flight dump + a stuck flag the lighthouse
+        # dashboard surfaces per replica.
+        telemetry.install_sigusr2()
+        # on_stall pushes the stuck report DIRECTLY to the lighthouse:
+        # the regular piggyback rides quorum RPCs, which a wedged step
+        # never issues — exactly the scenario the stuck flag exists for
+        self._watchdog = telemetry.StepWatchdog(on_stall=self._on_stall)
+        self._last_heal_ts = 0.0
+        telemetry.TRACER.set_context(
+            replica_id=self._replica_id, step=self._step, quorum_epoch=-1
+        )
+
+    def _on_stall(self, step: int, elapsed_s: float, threshold_s: float) -> None:
+        """Watchdog stall callback (watchdog thread): ship the stuck
+        report out-of-band. A wedged step sends no quorum RPCs, so the
+        normal piggyback can't carry the flag; push one heartbeat with
+        the telemetry payload straight to the lighthouse instead
+        (rank 0 only — it knows the lighthouse address). Best-effort and
+        time-bounded: forensics must never deepen a hang. Note this adds
+        no liveness signal the C++ manager's own heartbeat loop isn't
+        already sending — it only attaches the telemetry."""
+        if self._lighthouse_addr is None or self._shutting_down:
+            return
+
+        def _push() -> None:
+            try:
+                from torchft_tpu.coordination import LighthouseClient
+
+                client = LighthouseClient(
+                    self._lighthouse_addr, connect_timeout=timedelta(seconds=5)
+                )
+                try:
+                    client.heartbeat(
+                        self._replica_id,
+                        timeout=timedelta(seconds=5),
+                        telemetry_payload=self._telemetry_payload(),
+                    )
+                finally:
+                    client.close()
+            except Exception:  # noqa: BLE001 — best effort
+                pass
+
+        threading.Thread(target=_push, daemon=True, name="tft_stall_push").start()
+
+    def _trace_id(self) -> str:
+        """Trace identity for the in-flight step: (replica, step, epoch)
+        are globally agreed values, so spans from different replicas with
+        equal step/epoch coordinates correlate on the merged timeline."""
+        return f"{self._replica_id}:{self._step}:{self._quorum_id}"
+
+    def _telemetry_payload(self) -> Optional[Dict[str, Any]]:
+        """Compact per-replica report piggybacked on the quorum RPC:
+        counters digest + recent span batch + health scalars. The manager
+        server forwards it to the lighthouse for /cluster.json and the
+        merged /trace. Must never fail the quorum path. Kill switch:
+        ``TORCHFT_TELEMETRY_PIGGYBACK=0``."""
+        import json as _json
+
+        if os.environ.get("TORCHFT_TELEMETRY_PIGGYBACK", "1") == "0":
+            return None
+        try:
+            return {
+                "summary": _json.dumps(
+                    telemetry.summary(), separators=(",", ":"), default=str
+                ),
+                "step": self._step,
+                "stuck": bool(self._watchdog.stalled),
+                "last_heal_ts": float(self._last_heal_ts),
+                "spans": telemetry.TRACER.drain_chrome_fragment(),
+            }
+        except Exception:  # noqa: BLE001 — observability must not fail quorum
+            return None
+
     def set_state_dict_fns(
         self, load_state_dict: Callable[[T], None], state_dict: Callable[[], T]
     ) -> None:
@@ -258,6 +337,7 @@ class Manager:
     def shutdown(self, wait: bool = True) -> None:
         """Shut down the manager, checkpoint transport and data plane."""
         self._shutting_down = True
+        self._watchdog.stop()
         self._checkpoint_transport.shutdown(wait=wait)
         if self._manager is not None:
             self._manager.shutdown()
@@ -287,6 +367,12 @@ class Manager:
         self._group_healing = False
         self._step_epochs = set()
         self._step_n = None
+        telemetry.TRACER.set_context(
+            replica_id=self._replica_id,
+            step=self._step,
+            quorum_epoch=self._quorum_id,
+        )
+        self._watchdog.arm(self._step)
         telemetry.emit(
             "quorum_start",
             step=self._step,
@@ -341,24 +427,39 @@ class Manager:
         import time as _time
 
         t_quorum = _time.perf_counter()
-        quorum = self._client._quorum(
-            rank=self._rank,
-            step=self._step,
-            checkpoint_metadata=self._checkpoint_transport.metadata(),
-            shrink_only=shrink_only,
-            timeout=quorum_timeout,
-            # latched data-plane errors request a flush: quorum_id bumps so
-            # all groups (including healthy ones) re-rendezvous together
-            commit_failures=self._commit_failures,
-            # data-plane transport label for the lighthouse dashboard —
-            # lets an operator spot a group that fell back to a slower
-            # plane (e.g. CMA broken-latch converging everyone to TCP)
-            plane=(
-                self._collectives.plane_info()
-                if hasattr(self._collectives, "plane_info")
-                else type(self._collectives).__name__
-            ),
-        )
+        with telemetry.TRACER.span(
+            "quorum", trace_id=self._trace_id(), rank=self._rank
+        ) as q_span:
+            try:
+                quorum = self._client._quorum(
+                    rank=self._rank,
+                    step=self._step,
+                    checkpoint_metadata=self._checkpoint_transport.metadata(),
+                    shrink_only=shrink_only,
+                    timeout=quorum_timeout,
+                    # latched data-plane errors request a flush: quorum_id
+                    # bumps so all groups (healthy ones too) re-rendezvous
+                    commit_failures=self._commit_failures,
+                    # data-plane transport label for the lighthouse
+                    # dashboard — lets an operator spot a group that fell
+                    # back to a slower plane (e.g. CMA broken-latch
+                    # converging everyone to TCP)
+                    plane=(
+                        self._collectives.plane_info()
+                        if hasattr(self._collectives, "plane_info")
+                        else type(self._collectives).__name__
+                    ),
+                    # piggybacked telemetry: counters digest + span batch
+                    # for the lighthouse's /cluster.json and merged /trace
+                    telemetry_payload=self._telemetry_payload(),
+                )
+            except BaseException:
+                # the drained span batch never reached the lighthouse —
+                # requeue it so the outage window keeps its spans in the
+                # merged trace (the incident is exactly what /trace is for)
+                telemetry.TRACER.requeue_last_batch()
+                raise
+            q_span.set(quorum_id=quorum.quorum_id, heal=quorum.heal)
 
         # Async quorum overlaps the forward pass, so a healing replica can't
         # participate this step (its state is mid-flight) — take the max-step
@@ -428,6 +529,7 @@ class Manager:
                     list(quorum.participant_ids),
                 )
             self._quorum_id = quorum.quorum_id
+            telemetry.TRACER.set_context(quorum_epoch=quorum.quorum_id)
             telemetry.QUORUM_RECONFIGURES.inc()
             self.step_timer.mark_quorum()
             # fresh epoch: the flush request (if any) has been honored
@@ -440,12 +542,18 @@ class Manager:
                 self._logger.info(
                     f"peers need recovery from us {quorum.recover_dst_ranks}"
                 )
-                self._checkpoint_transport.send_checkpoint(
-                    dst_ranks=quorum.recover_dst_ranks,
+                with telemetry.TRACER.span(
+                    "heal_send",
+                    trace_id=self._trace_id(),
+                    dst_ranks=list(quorum.recover_dst_ranks),
                     step=quorum.max_step,
-                    state_dict=self._manager_state_dict(),
-                    timeout=self._timeout,
-                )
+                ):
+                    self._checkpoint_transport.send_checkpoint(
+                        dst_ranks=quorum.recover_dst_ranks,
+                        step=quorum.max_step,
+                        state_dict=self._manager_state_dict(),
+                        timeout=self._timeout,
+                    )
                 telemetry.HEALS_TOTAL.labels(role="send").inc(
                     len(quorum.recover_dst_ranks)
                 )
@@ -477,15 +585,21 @@ class Manager:
 
                 # the user state dict is only applied from the main thread;
                 # stage it here
-                self._pending_state_dict = cast(
-                    Dict[str, object],
-                    self._checkpoint_transport.recv_checkpoint(
-                        src_rank=quorum.recover_src_rank,
-                        metadata=checkpoint_metadata,
-                        step=quorum.max_step,
-                        timeout=self._timeout,
-                    ),
-                )
+                with telemetry.TRACER.span(
+                    "heal_recv",
+                    trace_id=self._trace_id(),
+                    src=quorum.recover_src_manager_address,
+                    step=quorum.max_step,
+                ):
+                    self._pending_state_dict = cast(
+                        Dict[str, object],
+                        self._checkpoint_transport.recv_checkpoint(
+                            src_rank=quorum.recover_src_rank,
+                            metadata=checkpoint_metadata,
+                            step=quorum.max_step,
+                            timeout=self._timeout,
+                        ),
+                    )
                 self.load_state_dict(
                     cast(Dict[str, int], self._pending_state_dict["torchft"])
                 )
@@ -500,6 +614,7 @@ class Manager:
                     nbytes = 0
                 telemetry.HEALS_TOTAL.labels(role="recv").inc()
                 telemetry.HEAL_DURATION.observe(heal_s)
+                self._last_heal_ts = _time.time()
                 self.step_timer.mark_heal()
                 telemetry.emit(
                     "heal_end",
@@ -851,12 +966,19 @@ class Manager:
         local_should_commit = (
             enough_replicas and self._errored is None and not mixed_epochs
         )
-        should_commit = self._client.should_commit(
-            self._rank,
-            self._step,
-            local_should_commit,
-            timeout=timeout or self._timeout,
-        )
+        with telemetry.TRACER.span(
+            "should_commit",
+            trace_id=self._trace_id(),
+            vote=local_should_commit,
+        ) as sc_span:
+            should_commit = self._client.should_commit(
+                self._rank,
+                self._step,
+                local_should_commit,
+                timeout=timeout or self._timeout,
+            )
+            sc_span.set(decision=should_commit)
+        self._watchdog.disarm()
         telemetry.COMMIT_BARRIER.observe(_time.perf_counter() - t_commit)
         self._logger.info(
             f"should_commit={should_commit} enough_replicas={enough_replicas} "

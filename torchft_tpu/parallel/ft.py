@@ -69,7 +69,17 @@ class FTTrainer:
         self._params = jax.device_put(
             state["params"], self._ts._param_shardings
         )
-        # opt_state shardings mirror params; let placement follow use
+        # opt_state shardings mirror params; let placement follow use.
+        # NOTE (flake post-mortem, PR 2): transferred dense leaves stay as
+        # UNCOMMITTED host arrays on purpose. Re-committing them onto the
+        # live tree's shardings via device_put looks like the obvious
+        # placement-parity fix for the healed replica's retrace churn, but
+        # in a multi-controller group it is wrong: jit-output scalars
+        # (e.g. adam's count) carry shardings that device_put resolves to
+        # THIS process's single local device, and the next `apply` then
+        # rejects the mix of a global-mesh param with a single-device
+        # opt leaf ("Received incompatible devices"). Leaving the leaves
+        # uncommitted lets jit place them consistently on every process.
         self._opt_state = state["opt_state"]
 
     # -- drive --

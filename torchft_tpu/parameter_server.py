@@ -20,6 +20,7 @@ import uuid
 from abc import ABC, abstractmethod
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from torchft_tpu import telemetry
 from torchft_tpu.collectives import Collectives
 from torchft_tpu.store import StoreServer
 
@@ -48,6 +49,22 @@ class ParameterServer(ABC):
                 pass
 
             def do_GET(self) -> None:
+                # Prometheus exposition, same route every HTTPTransport
+                # serves — the parameter server runs its own HTTP surface
+                # and was missed in PR 1's exposition sweep.
+                if self.path.rstrip("/") == "/metrics":
+                    body = telemetry.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    try:
+                        self.wfile.write(body)
+                    except BrokenPipeError:
+                        pass
+                    return
                 if self.path != "/new_session":
                     self.send_error(400, f"invalid path {self.path}")
                     return

@@ -30,7 +30,18 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from torchft_tpu.telemetry.events import ENV_TRAIL_PATH, EventTrail, read_trail
+from torchft_tpu.telemetry.events import (
+    CANONICAL_EVENTS,
+    ENV_TRAIL_PATH,
+    EventTrail,
+    read_trail,
+)
+from torchft_tpu.telemetry.flight import (
+    FLIGHT,
+    FlightRecorder,
+    StepWatchdog,
+    install_sigusr2,
+)
 from torchft_tpu.telemetry.registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -38,17 +49,27 @@ from torchft_tpu.telemetry.registry import (
     Histogram,
     MetricsRegistry,
 )
+from torchft_tpu.telemetry.tracing import TRACER, Span, Tracer, chrome_trace
 
 __all__ = [
     "REGISTRY",
     "EVENTS",
+    "TRACER",
+    "FLIGHT",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "EventTrail",
     "read_trail",
+    "CANONICAL_EVENTS",
     "ENV_TRAIL_PATH",
+    "Span",
+    "Tracer",
+    "chrome_trace",
+    "FlightRecorder",
+    "StepWatchdog",
+    "install_sigusr2",
     "counter",
     "gauge",
     "histogram",
@@ -182,6 +203,22 @@ FT_EVENTS_TOTAL = REGISTRY.counter(
     labelnames=("event",),
 )
 
+# tracing / flight recorder / watchdog
+TRACE_SPANS = REGISTRY.counter(
+    "tft_trace_spans_total",
+    "Distributed trace spans recorded, by span name",
+    labelnames=("span",),
+)
+WATCHDOG_STALLS = REGISTRY.counter(
+    "tft_watchdog_stalls_total",
+    "Step-watchdog firings (a step exceeded the p99-derived threshold)",
+)
+FLIGHT_DUMPS = REGISTRY.counter(
+    "tft_flight_dumps_total",
+    "Collective flight-recorder dumps written, by trigger reason",
+    labelnames=("reason",),
+)
+
 # Pre-create the CLOSED label sets so their series exist (zero-valued)
 # from process start: dashboards and absent-series alerts can then tell
 # "healthy, zero heals" from "trainer not scraped". Open-ended label sets
@@ -194,7 +231,9 @@ for _kind in ("steady", "quorum", "heal"):
     STEP_DURATION.labels(kind=_kind)
 for _result in ("evicted", "rejected", "failed"):
     EVICTIONS_REPORTED.labels(result=_result)
-del _role, _outcome, _kind, _result
+for _reason in ("signal", "deadline", "watchdog", "manual"):
+    FLIGHT_DUMPS.labels(reason=_reason)
+del _role, _outcome, _kind, _result, _reason
 
 
 # ---------------------------------------------------------------------------
@@ -313,6 +352,9 @@ def summary() -> Dict[str, Any]:
 
 
 def reset() -> None:
-    """Zero every metric in place and empty the event ring (tests)."""
+    """Zero every metric in place and empty the event/span/flight rings
+    (tests)."""
     REGISTRY.reset_values()
     EVENTS.clear()
+    TRACER.clear()
+    FLIGHT.clear()

@@ -31,20 +31,62 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional
 
-__all__ = ["EventTrail", "read_trail"]
+__all__ = ["EventTrail", "read_trail", "CANONICAL_EVENTS"]
 
 ENV_TRAIL_PATH = "TORCHFT_EVENT_TRAIL"
+ENV_TRAIL_MAX_BYTES = "TORCHFT_EVENT_TRAIL_MAX_BYTES"
+
+# Soak runs must not grow the trail unboundedly: past this many bytes the
+# sink rolls to `<path>.1` (one generation kept) and starts fresh. 0
+# disables rotation.
+DEFAULT_TRAIL_MAX_BYTES = 64 << 20
+
+# The documented event vocabulary (docs/observability.md "FT event trail"
+# table). The drift-check test asserts doc <-> code agreement in both
+# directions, so adding a kind here without documenting it (or vice versa)
+# fails CI.
+CANONICAL_EVENTS = (
+    "quorum_start",
+    "quorum_ready",
+    "heal_begin",
+    "heal_end",
+    "peer_death",
+    "eviction",
+    "commit",
+    "abort",
+    "checkpoint_send",
+    "checkpoint_recv",
+    "step_outlier",
+    "watchdog_stall",
+    "flight_dump",
+)
 
 
 class EventTrail:
     """Thread-safe JSONL event sink with an in-memory ring buffer."""
 
-    def __init__(self, path: Optional[str] = None, maxlen: int = 4096) -> None:
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        maxlen: int = 4096,
+        max_bytes: Optional[int] = None,
+    ) -> None:
         self._lock = threading.Lock()
         self._ring: Deque[Dict[str, Any]] = deque(maxlen=maxlen)
         self._file: Optional[io.TextIOBase] = None
         self._path: Optional[str] = None
         self._env_checked = False
+        self._written = 0
+        if max_bytes is None:
+            try:
+                max_bytes = int(
+                    os.environ.get(
+                        ENV_TRAIL_MAX_BYTES, str(DEFAULT_TRAIL_MAX_BYTES)
+                    )
+                )
+            except ValueError:
+                max_bytes = DEFAULT_TRAIL_MAX_BYTES
+        self.max_bytes = max_bytes
         if path:
             self.configure(path)
 
@@ -67,6 +109,7 @@ class EventTrail:
                 if d:
                     os.makedirs(d, exist_ok=True)
                 self._file = open(path, "a", encoding="utf-8")
+                self._written = self._existing_size(path)
 
     def path(self) -> Optional[str]:
         with self._lock:
@@ -86,10 +129,44 @@ class EventTrail:
                 os.makedirs(d, exist_ok=True)
             self._file = open(path, "a", encoding="utf-8")
             self._path = path
+            self._written = self._existing_size(path)
         except OSError:
             # observability must never take down training
             self._file = None
             self._path = None
+
+    @staticmethod
+    def _existing_size(path: str) -> int:
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return 0
+
+    def _maybe_rotate(self) -> None:
+        # called under self._lock, after a successful write+flush. One
+        # rolled generation (`<path>.1`) bounds total disk at ~2x max_bytes
+        # while keeping enough history to reconstruct a recent incident.
+        if (
+            self.max_bytes <= 0
+            or self._file is None
+            or self._path is None
+            or self._written < self.max_bytes
+        ):
+            return
+        try:
+            self._file.close()
+        except OSError:
+            pass
+        self._file = None
+        try:
+            os.replace(self._path, self._path + ".1")
+        except OSError:
+            pass  # rotation is best-effort; keep appending either way
+        try:
+            self._file = open(self._path, "a", encoding="utf-8")
+            self._written = self._existing_size(self._path)
+        except OSError:
+            self._file = None
 
     # -- producer side --
 
@@ -105,6 +182,8 @@ class EventTrail:
                     line = json.dumps(record, default=str)
                     self._file.write(line + "\n")
                     self._file.flush()
+                    self._written += len(line) + 1
+                    self._maybe_rotate()
                 except (OSError, ValueError):
                     pass  # a full disk must not fail a step
         # metric alongside the trail so dashboards can rate() FT events

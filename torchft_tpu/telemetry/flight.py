@@ -1,0 +1,375 @@
+"""Collective flight recorder + step watchdog — hang forensics.
+
+A silent multihost hang is the worst FT failure mode: every process is
+alive, nothing errors, and the only observable fact is "step N never
+committed". PyTorch distributed grew the NCCL flight recorder for exactly
+this; here the analogue is a fixed-size ring of the last N cross-group
+collective ops (op, plane, bytes, issue/complete wall timestamps,
+status), recorded by both data-plane backends. When something wedges, the
+per-rank dumps answer the two questions that localize a hang: *what was
+the last op this rank completed* and *what is the first op it is stuck
+in* — diffing those across ranks names the rank (and usually the op) that
+stalled the ring.
+
+Dumps are triggered three ways:
+
+* **SIGUSR2** — operator-initiated (``kill -USR2 <pid>`` on a wedged
+  worker); handler installed by the Manager (main thread only);
+* **deadline expiry** — the futures timeout manager dumps when it fails
+  a future (rate-limited);
+* **step watchdog** — :class:`StepWatchdog` fires when the step a
+  Manager armed exceeds ``TORCHFT_WATCHDOG_MULT`` × the steady-step p99
+  (floor ``TORCHFT_WATCHDOG_MIN_S``), i.e. the step is an extreme outlier
+  against this process's own recorded history.
+
+Dump files are JSON at ``TORCHFT_FLIGHT_DIR`` (default: the system temp
+dir), named ``tft_flight_<pid>_<seq>.json``. Stdlib-only; recording an op
+is one lock + a few dict stores.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "FlightRecorder",
+    "FLIGHT",
+    "StepWatchdog",
+    "install_sigusr2",
+    "ENV_FLIGHT_DIR",
+    "ENV_FLIGHT_SIZE",
+]
+
+ENV_FLIGHT_DIR = "TORCHFT_FLIGHT_DIR"
+ENV_FLIGHT_SIZE = "TORCHFT_FLIGHT_SIZE"
+ENV_WATCHDOG_MULT = "TORCHFT_WATCHDOG_MULT"
+ENV_WATCHDOG_MIN_S = "TORCHFT_WATCHDOG_MIN_S"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+class FlightRecorder:
+    """Fixed-size ring of collective-op records.
+
+    ``record_issue`` returns a sequence id; ``record_complete(seq)`` marks
+    it completed/failed if it is still in the ring (wraparound of a long
+    ring while an op is in flight simply loses the record — acceptable,
+    the recorder is forensic, not accounting)."""
+
+    def __init__(self, size: Optional[int] = None) -> None:
+        if size is None:
+            try:
+                size = max(16, int(os.environ.get(ENV_FLIGHT_SIZE, "256")))
+            except ValueError:
+                size = 256
+        self._size = size
+        self._lock = threading.Lock()
+        self._ring: List[Optional[Dict[str, Any]]] = [None] * size
+        self._seq = 0
+        self._dump_seq = 0
+        self._last_dump: Dict[str, float] = {}  # reason -> monotonic ts
+        self.min_dump_interval_s = 5.0
+
+    # -- producer side ---------------------------------------------------
+
+    def record_issue(
+        self,
+        op: str,
+        plane: str,
+        nbytes: int = 0,
+        tag: int = 0,
+        rank: int = -1,
+    ) -> int:
+        rec = {
+            "seq": 0,
+            "op": op,
+            "plane": plane,
+            "bytes": int(nbytes),
+            "tag": tag,
+            "rank": rank,
+            "issue_ts": time.time(),
+            "complete_ts": None,
+            "status": "issued",
+        }
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring[self._seq % self._size] = rec
+            return self._seq
+
+    def record_complete(self, seq: int, error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            rec = self._ring[seq % self._size]
+            if rec is None or rec["seq"] != seq:
+                return  # overwritten by wraparound
+            rec["complete_ts"] = time.time()
+            rec["status"] = "completed" if error is None else "failed"
+            if error is not None:
+                rec["error"] = repr(error)
+
+    # -- consumer side ---------------------------------------------------
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Records oldest→newest (deep-enough copies for JSON dumping)."""
+        with self._lock:
+            recs = [dict(r) for r in self._ring if r is not None]
+        recs.sort(key=lambda r: r["seq"])
+        return recs
+
+    @staticmethod
+    def analyze(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """The hang-localization digest: the newest completed op and the
+        oldest still-issued one."""
+        last_completed = None
+        first_stuck = None
+        for r in entries:
+            if r["status"] == "completed":
+                last_completed = r
+            elif r["status"] == "issued" and first_stuck is None:
+                first_stuck = r
+        return {"last_completed": last_completed, "first_stuck": first_stuck}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = [None] * self._size
+
+    # -- dumping ---------------------------------------------------------
+
+    def dump_dir(self) -> str:
+        return os.environ.get(ENV_FLIGHT_DIR) or tempfile.gettempdir()
+
+    def dump(self, reason: str, force: bool = False) -> Optional[str]:
+        """Write the ring to disk; returns the path (None when rate-limited
+        or the write failed). ``force`` skips the per-reason rate limit
+        (the SIGUSR2 path — an explicit operator ask always dumps)."""
+        now = time.monotonic()
+        with self._lock:
+            if not force:
+                last = self._last_dump.get(reason, 0.0)
+                if now - last < self.min_dump_interval_s:
+                    return None
+            self._last_dump[reason] = now
+            self._dump_seq += 1
+            seq = self._dump_seq
+        entries = self.snapshot()
+        payload = {
+            "reason": reason,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "host": _hostname(),
+            "entries": entries,
+            **self.analyze(entries),
+        }
+        path = os.path.join(
+            self.dump_dir(), f"tft_flight_{os.getpid()}_{seq}.json"
+        )
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(payload, f, default=str)
+        except OSError:
+            return None
+        try:
+            from torchft_tpu import telemetry
+
+            telemetry.FLIGHT_DUMPS.labels(reason=reason).inc()
+            telemetry.emit("flight_dump", reason=reason, path=path)
+        except Exception:  # noqa: BLE001 — never fail the trigger path
+            pass
+        return path
+
+
+def _hostname() -> str:
+    import socket
+
+    try:
+        return socket.gethostname()
+    except OSError:
+        return "?"
+
+
+FLIGHT = FlightRecorder()
+
+_SIGUSR2_INSTALLED = False
+_SIGUSR2_LOCK = threading.Lock()
+
+
+def install_sigusr2() -> bool:
+    """Install the SIGUSR2 → flight dump handler (idempotent; main thread
+    only — returns False when installation was impossible, e.g. called
+    from a worker thread or a non-Unix platform)."""
+    global _SIGUSR2_INSTALLED
+    with _SIGUSR2_LOCK:
+        if _SIGUSR2_INSTALLED:
+            return True
+        try:
+            prev = signal.getsignal(signal.SIGUSR2)
+
+            def _handler(signum, frame):  # noqa: ARG001
+                # dump on a thread: json/file IO is not async-signal-safe
+                # enough to run inline in an arbitrary interrupted frame
+                threading.Thread(
+                    target=FLIGHT.dump,
+                    args=("signal",),
+                    kwargs={"force": True},
+                    daemon=True,
+                    name="tft_flight_dump",
+                ).start()
+                if callable(prev) and prev not in (
+                    signal.SIG_IGN,
+                    signal.SIG_DFL,
+                ):
+                    prev(signum, frame)
+
+            signal.signal(signal.SIGUSR2, _handler)
+        except (ValueError, OSError, AttributeError):
+            return False
+        _SIGUSR2_INSTALLED = True
+        return True
+
+
+class StepWatchdog:
+    """Per-Manager stall detector driven by the step-duration histogram.
+
+    ``arm(step)`` at each ``start_quorum``; ``disarm()`` at the commit
+    boundary. A monitor thread compares the armed step's elapsed wall time
+    against ``mult × p99(steady step duration)`` (floor ``min_s``); past
+    the threshold it fires ``on_stall`` once for that step, dumps the
+    flight recorder, and latches :attr:`stalled` until the next disarm —
+    the Manager piggybacks that flag to the lighthouse so the cluster
+    dashboard shows a stuck-collective marker for the replica.
+
+    Knobs (env): ``TORCHFT_WATCHDOG_MULT`` (default 10; <=0 disables) and
+    ``TORCHFT_WATCHDOG_MIN_S`` (default 60)."""
+
+    WARMUP_SAMPLES = 8
+
+    def __init__(
+        self,
+        mult: Optional[float] = None,
+        min_s: Optional[float] = None,
+        on_stall: Optional[Callable[[int, float, float], None]] = None,
+        recorder: Optional[FlightRecorder] = None,
+    ) -> None:
+        self.mult = mult if mult is not None else _env_float(ENV_WATCHDOG_MULT, 10.0)
+        self.min_s = min_s if min_s is not None else _env_float(ENV_WATCHDOG_MIN_S, 60.0)
+        self._on_stall = on_stall
+        self._recorder = recorder or FLIGHT
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._armed_step: Optional[int] = None
+        self._armed_at = 0.0
+        self._fired_step: Optional[int] = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = True
+        self.stalled = False
+        self.stalls = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.mult > 0
+
+    def threshold_s(self) -> float:
+        """Current stall threshold; the histogram p99 only engages after
+        WARMUP_SAMPLES steady steps so cold starts never false-positive."""
+        p99 = None
+        try:
+            from torchft_tpu import telemetry
+
+            steady = telemetry.STEP_DURATION.labels(kind="steady")
+            if steady.count >= self.WARMUP_SAMPLES:
+                p99 = steady.quantile(0.99)
+        except Exception:  # noqa: BLE001
+            p99 = None
+        if not p99:
+            return self.min_s
+        return max(self.min_s, self.mult * p99)
+
+    def arm(self, step: int) -> None:
+        if not self.enabled:
+            return
+        with self._cond:
+            self._armed_step = step
+            self._armed_at = time.monotonic()
+            if self._fired_step != step:
+                self.stalled = False
+            if self._thread is None and self._running:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="tft_step_watchdog"
+                )
+                self._thread.start()
+            self._cond.notify()
+
+    def disarm(self) -> None:
+        with self._cond:
+            self._armed_step = None
+            self.stalled = False
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                if not self._running:
+                    return
+                if self._armed_step is None:
+                    self._cond.wait(timeout=1.0)
+                    continue
+                step = self._armed_step
+                elapsed = time.monotonic() - self._armed_at
+            thr = self.threshold_s()
+            if elapsed >= thr and step is not None:
+                fire = False
+                with self._cond:
+                    if self._armed_step == step and self._fired_step != step:
+                        self._fired_step = step
+                        self.stalled = True
+                        self.stalls += 1
+                        fire = True
+                if fire:
+                    self._fire(step, elapsed, thr)
+                wait_s = max(1.0, thr / 4)
+            else:
+                wait_s = min(max(0.05, thr - elapsed), max(1.0, thr / 4))
+            with self._cond:
+                if self._running:
+                    self._cond.wait(timeout=wait_s)
+
+    def _fire(self, step: int, elapsed: float, thr: float) -> None:
+        try:
+            from torchft_tpu import telemetry
+
+            telemetry.WATCHDOG_STALLS.inc()
+            telemetry.emit(
+                "watchdog_stall",
+                step=step,
+                elapsed_s=round(elapsed, 3),
+                threshold_s=round(thr, 3),
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self._recorder.dump("watchdog")
+        except Exception:  # noqa: BLE001
+            pass
+        if self._on_stall is not None:
+            try:
+                self._on_stall(step, elapsed, thr)
+            except Exception:  # noqa: BLE001
+                pass

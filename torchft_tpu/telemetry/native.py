@@ -17,7 +17,12 @@ import json
 import urllib.request
 from typing import Any, Dict, Optional
 
-__all__ = ["poll_lighthouse", "scrape_lighthouse_metrics"]
+__all__ = [
+    "poll_lighthouse",
+    "scrape_lighthouse_metrics",
+    "poll_cluster",
+    "fetch_merged_trace",
+]
 
 
 def _base_url(addr: str) -> str:
@@ -52,3 +57,42 @@ def scrape_lighthouse_metrics(addr: str, timeout: float = 2.0) -> str:
             return resp.read().decode()
     except Exception:  # noqa: BLE001
         return ""
+
+
+def poll_cluster(addr: str, timeout: float = 2.0) -> Optional[Dict[str, Any]]:
+    """Fetch the lighthouse's ``/cluster.json`` aggregation: per-replica
+    last report age, step, stuck flag, heal recency and counters digest
+    (each replica's ``telemetry.summary()``, piggybacked on its quorum
+    traffic). None when unreachable."""
+    try:
+        with urllib.request.urlopen(
+            f"{_base_url(addr)}/cluster.json", timeout=timeout
+        ) as resp:
+            return json.loads(resp.read().decode())
+    except Exception:  # noqa: BLE001 — degrade, don't raise
+        return None
+
+
+def fetch_merged_trace(
+    addr: str, path: Optional[str] = None, timeout: float = 5.0
+) -> Optional[Dict[str, Any]]:
+    """Fetch the lighthouse's merged Chrome trace (``GET /trace``) — every
+    replica's recent spans on one timeline. With ``path``, also write the
+    raw JSON to disk ready to open in Perfetto. None when unreachable."""
+    try:
+        with urllib.request.urlopen(
+            f"{_base_url(addr)}/trace", timeout=timeout
+        ) as resp:
+            raw = resp.read()
+    except Exception:  # noqa: BLE001
+        return None
+    if path:
+        try:
+            with open(path, "wb") as f:
+                f.write(raw)
+        except OSError:
+            pass
+    try:
+        return json.loads(raw.decode())
+    except ValueError:
+        return None
