@@ -176,6 +176,10 @@ def _run_json_subprocess(cmd, timeout_s: float, env_extra=None) -> dict:
         stderr=subprocess.PIPE,
         env=env,
         start_new_session=True,
+        # the child's `python -m torchft_tpu.benchmarks.*` resolves the
+        # package from its cwd; anchor it to the repo root so bench.py
+        # works when invoked from anywhere (ADVICE r5 #1)
+        cwd=os.path.dirname(os.path.abspath(__file__)),
     )
     try:
         out, err = proc.communicate(timeout=timeout_s)
@@ -254,9 +258,37 @@ def _apply_regression_gate(extra: dict, headline_sps: float) -> None:
             if isinstance(subrow, dict) and isinstance(base_sub, dict):
                 gate_row(f"{name}.{sub}", subrow, base_sub, tol)
 
+    def gate_resnet_on_max(row: dict, base_row: dict) -> bool:
+        """resnet18_cifar is dispatch-latency-bound: its isolated
+        per-invocation median spans 44-96 steps/s on this box, wider than
+        any sane tolerance. Contention only SUBTRACTS (the
+        cpu_mesh_2group rationale), so gate on max(runs) — the run least
+        touched by tunnel weather — instead of the median (ADVICE r5 #4).
+        Returns True when the max-run gate applied (generic gate skipped)."""
+        now_runs = row.get("runs_steps_per_sec")
+        was_runs = base_row.get("runs_steps_per_sec")
+        if not (
+            isinstance(now_runs, list) and now_runs
+            and isinstance(was_runs, list) and was_runs
+        ):
+            return False  # old-format row: fall back to the generic gate
+        now, was = max(now_runs), max(was_runs)
+        if not was:
+            return False
+        delta = (now / was - 1.0) * 100.0
+        row["delta_vs_prev_pct_max_steps_per_sec"] = round(delta, 1)
+        if delta < -_GATE_WIDE_TOLERANCE_PCT:
+            regressions.append(
+                f"resnet18_cifar.max(runs_steps_per_sec): {was} -> {now} "
+                f"({delta:+.1f}%)"
+            )
+        return True
+
     for name, row in extra.items():
         base_row = baseline.get(name)
         if isinstance(row, dict) and isinstance(base_row, dict):
+            if name == "resnet18_cifar" and gate_resnet_on_max(row, base_row):
+                continue
             tol = (
                 _GATE_WIDE_TOLERANCE_PCT
                 if name in _GATE_WIDE_ROWS
@@ -363,6 +395,29 @@ def main() -> None:
         },
     }
 
+    # Split the per-step FT control cost into its two serial RPCs
+    # (quorum vs commit) from the histograms the headline loop just fed —
+    # the commit vote is the piece the commit_pipeline extra hides, so
+    # this row is the "how much is left to hide" companion to it. p50s,
+    # accumulated over all in-process headline runs (both variants).
+    try:
+        from torchft_tpu import telemetry as _tm
+
+        q50 = _tm.QUORUM_LATENCY.quantile(0.5) or 0.0
+        c50 = _tm.COMMIT_BARRIER.quantile(0.5) or 0.0
+        step_s = 1.0 / sps if sps else 0.0
+        extra["ft_control_overhead_split"] = {
+            "quorum_rpc_p50_s": round(q50, 6),
+            "commit_barrier_p50_s": round(c50, 6),
+            "quorum_pct_of_step": round(q50 / step_s * 100.0, 2) if step_s else None,
+            "commit_pct_of_step": round(c50 / step_s * 100.0, 2) if step_s else None,
+            "note": "quorum overlaps the forward pass (use_async_quorum); "
+            "the commit barrier is serial unless commit_pipeline=1 — see "
+            "the commit_pipeline extra for the pipelined A/B",
+        }
+    except Exception as e:  # noqa: BLE001 — observability never fails bench
+        extra["ft_control_overhead_split"] = {"error": str(e)}
+
     # ResNet-18 CIFAR (BASELINE.md config list): conv family through the
     # same FT loop; imgs/s per chip. OWN process, first touch of the chip
     # among subprocess extras — round-4's 88->49 "regression" was suite
@@ -417,6 +472,19 @@ def main() -> None:
         )
     except Exception as e:  # noqa: BLE001
         extra["quorum_overlap"] = {"error": str(e)}
+
+    # pipelined-vs-sync COMMIT barrier, same protocol as quorum_overlap:
+    # 2 groups + a synthetic RTT on the should_commit RPC, interleaved
+    # median-of-7 with spreads — the artifact behind commit_pipeline=True
+    # (this PR's tentpole; speculative apply + rollback machinery live)
+    try:
+        extra["commit_pipeline"] = _run_json_subprocess(
+            [sys.executable, "-m", "torchft_tpu.benchmarks.commit_pipeline"],
+            timeout_s=900,
+            env_extra={"JAX_PLATFORMS": "cpu"},
+        )
+    except Exception as e:  # noqa: BLE001
+        extra["commit_pipeline"] = {"error": str(e)}
 
     # REAL on-chip 2-group averaging: two processes time-sharing the chip
     # over the host plane (round-4 review weak #8). See the module

@@ -1039,6 +1039,9 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
     me.commit_failures = pending_commit_failures_;
     me.plane = pending_plane_;
     pending_commit_failures_ = 0;
+    // consumed like the flush counter above: a later quorum round that
+    // omits 'plane' must not report this epoch's stale transport label
+    pending_plane_.clear();
     Value lreq = Value::M();
     lreq.set("requester", me.to_value());
     if (!pending_telemetry_.is_none()) {

@@ -61,6 +61,16 @@ def proxy_pair():
 
 
 class TestCollectivesProxy:
+    def test_plane_info_reports_inner_backend(self, proxy_pair):
+        """The proxy labels the child's LIVE transport (proxy:<inner>), so
+        a silent CMA->TCP fallback stays visible on the dashboard even
+        under the kill-safe deployment (ADVICE r5 #2)."""
+        for p in proxy_pair:
+            info = p.plane_info()
+            assert info.startswith("proxy:") and len(info) > len("proxy:"), info
+            # the inner label is the TCP backend's routing, not a class name
+            assert "CollectivesTcp" not in info
+
     def test_allreduce_shm_path(self, proxy_pair):
         """Buckets above the shm threshold ride shared memory (one copy
         each way, no pickle) and still land in-place in caller buffers —

@@ -1,0 +1,188 @@
+"""Pipelined-vs-sync commit at a config where the vote RPC costs something.
+
+Companion to ``quorum_overlap`` (same protocol): TWO replica groups over
+the host TCP plane, with a synthetic round-trip injected into the
+``should_commit`` vote RPC (``--rtt-ms``, default 10 — the off-host
+control-plane hop of the reference README topology; for a multi-host
+group the rank-0 manager server is a network hop away from every other
+rank, so the vote barrier pays it every step). Sync mode pays
+``work + rtt`` serially per step; pipelined mode issues the vote
+asynchronously and the NEXT step's forward pass covers the RTT
+(``max(work, rtt)``), with the speculative-update/rollback machinery
+live (no faults are injected here, so no rollbacks occur — the
+fault-path parity is covered by tests/test_commit_pipeline.py).
+
+Protocol: interleaved A/B (pipelined, sync, pipelined, ...) with
+``--runs`` pairs (default 7), reporting per-variant median and spread —
+one hot pair would let host contamination on a single leg fabricate the
+result.
+
+Run: ``python -m torchft_tpu.benchmarks.commit_pipeline`` (CPU platform;
+prints one JSON line).
+"""
+
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from typing import List
+
+
+def _install_vote_rtt(rtt_s: float) -> None:
+    """Inject the synthetic RTT into EVERY ManagerClient.should_commit in
+    this process (class-level, so the pipelined variant's dedicated vote
+    client takes the identical delayed path as the sync variant's shared
+    client). The quorum RPC is untouched: async quorum already hides it,
+    and this extra isolates the COMMIT barrier."""
+    from torchft_tpu.coordination import ManagerClient
+
+    if getattr(ManagerClient, "_cp_bench_patched", False):
+        return
+    real = ManagerClient.should_commit
+
+    def slow(self, *args, **kwargs):
+        time.sleep(rtt_s)
+        return real(self, *args, **kwargs)
+
+    ManagerClient.should_commit = slow
+    ManagerClient._cp_bench_patched = True
+
+
+def _train_group(
+    replica_id: int,
+    lighthouse_addr: str,
+    pipelined: bool,
+    steps: int,
+    work_ms: float,
+) -> float:
+    """One replica group (thread): real Manager + TCP collectives, a
+    fixed-duration 'forward pass', and the per-step quorum+commit path.
+    Returns steps/s for the timed window."""
+    import numpy as np
+
+    from torchft_tpu.collectives import CollectivesTcp
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.store import StoreServer
+
+    store = StoreServer()
+    manager = Manager(
+        collectives=CollectivesTcp(timeout=timedelta(seconds=20)),
+        load_state_dict=lambda s: None,
+        state_dict=lambda: {},
+        min_replica_size=2,
+        replica_id=f"cp_{replica_id}",
+        store_addr=store.address(),
+        rank=0,
+        world_size=1,
+        lighthouse_addr=lighthouse_addr,
+        use_async_quorum=True,
+        commit_pipeline=pipelined,
+        timeout=timedelta(seconds=20),
+    )
+
+    grad = np.ones(1 << 16, dtype=np.float32)
+    try:
+        def step() -> None:
+            manager.start_quorum()
+            # the "forward pass": sleep, not a busy-wait — two groups
+            # share this box and a GIL-holding spin would starve the
+            # async quorum/vote threads, corrupting the very ratio being
+            # measured. sleep models off-host device compute faithfully.
+            # In pipelined mode the PREVIOUS step's vote RTT hides here.
+            time.sleep(work_ms / 1e3)
+            if pipelined:
+                manager.resolve_pending_commit()
+            manager.allreduce(grad.copy()).wait()
+            if pipelined and manager.speculation_allowed():
+                # same gate the trainers use: a healing/doomed step takes
+                # the sync path (e.g. the cold-start quorum marks the
+                # later joiner healing)
+                manager.should_commit_async()
+            else:
+                manager.should_commit()
+
+        for _ in range(3):
+            step()  # warmup: first quorum forms the group
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            step()
+        if pipelined:
+            # the trailing vote belongs to the timed work — resolve it
+            # inside the window so both variants count `steps` full votes
+            manager.resolve_pending_commit(rearm=False)
+        return steps / (time.perf_counter() - t0)
+    finally:
+        manager.shutdown(wait=False)
+        store.shutdown()
+
+
+def _one_run(lighthouse_addr: str, pipelined: bool, steps: int,
+             work_ms: float) -> float:
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        futs = [
+            ex.submit(
+                _train_group, g, lighthouse_addr, pipelined, steps, work_ms
+            )
+            for g in range(2)
+        ]
+        rates = [f.result() for f in futs]
+    return min(rates)  # the group rate is gated by the slower member
+
+
+def main() -> None:
+    import argparse
+
+    from torchft_tpu import telemetry
+    from torchft_tpu.coordination import LighthouseServer
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rtt-ms", type=float, default=10.0)
+    ap.add_argument("--runs", type=int, default=7)
+    # 25 steps/leg: shorter legs let setup jitter dominate the medians
+    # (15-step legs swung ±45% on this box; 25-step legs hold ~±2%)
+    ap.add_argument("--steps", type=int, default=25)
+    ap.add_argument("--work-ms", type=float, default=30.0)
+    args = ap.parse_args()
+
+    _install_vote_rtt(args.rtt_ms / 1e3)
+
+    piped_runs: List[float] = []
+    sync_runs: List[float] = []
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+    try:
+        for _ in range(args.runs):  # interleaved: both see the same drift
+            piped_runs.append(
+                _one_run(lighthouse.address(), True, args.steps, args.work_ms)
+            )
+            sync_runs.append(
+                _one_run(lighthouse.address(), False, args.steps, args.work_ms)
+            )
+    finally:
+        lighthouse.shutdown()
+
+    piped_runs.sort()
+    sync_runs.sort()
+    p_med = piped_runs[len(piped_runs) // 2]
+    s_med = sync_runs[len(sync_runs) // 2]
+    print(json.dumps({
+        "pipelined_steps_per_sec": round(p_med, 3),
+        "sync_steps_per_sec": round(s_med, 3),
+        "pipelined_gain_pct": round((p_med / s_med - 1) * 100.0, 2),
+        "pipelined_runs": [round(r, 3) for r in piped_runs],
+        "sync_runs": [round(r, 3) for r in sync_runs],
+        "pipelined_spread_pct": round(
+            (piped_runs[-1] - piped_runs[0]) / p_med * 100.0, 1
+        ),
+        "sync_spread_pct": round(
+            (sync_runs[-1] - sync_runs[0]) / s_med * 100.0, 1
+        ),
+        # no faults injected: any rollback here would be a bug
+        "rollbacks": int(telemetry.COMMIT_PIPELINE_ROLLBACKS.value),
+        "config": f"2 groups, host TCP plane, synthetic +{args.rtt_ms} ms "
+        f"RTT on the should_commit RPC, {args.work_ms} ms forward, "
+        f"interleaved median of {args.runs}",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,15 @@ the TCP ring on the collectives op thread, bucket k+1's device→host
 transfers complete on the main thread and bucket k−1's averaged pieces
 are already being device_put back — so wire time hides behind transfer
 time instead of adding to it.
+
+Pipelined-commit note (docs/commit_pipeline.md): callers must resolve
+any in-flight commit vote (``manager.resolve_pending_commit()``) before
+calling :func:`allreduce_gradients` for the next step — the Manager
+raises otherwise, because gradients of a speculative (possibly about to
+be rolled back) state must never enter a collective. The bucket buffers
+here always own their memory (``np.concatenate`` / explicit ``copy``),
+so the in-place ring reduction can never corrupt the caller's retained
+gradient pytree across a rollback/replay.
 """
 
 from __future__ import annotations

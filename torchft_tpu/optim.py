@@ -18,11 +18,21 @@ replaces the internal pytrees before the update applies::
 ``step`` averages gradients across replica groups through the Manager and
 applies the optax update only if ``should_commit()`` — otherwise the state
 is untouched and the step is discarded.
+
+Pipelined commit (``Manager(commit_pipeline=True)``,
+docs/commit_pipeline.md): ``step`` applies the update speculatively,
+issues the vote asynchronously, and the vote from step *k* resolves inside
+step *k+1*'s ``step()`` — so the value_and_grad between ``begin_step`` and
+``step`` overlaps the vote RTT. On a veto the pre-update pytrees are
+restored; pass ``grad_fn`` (``params -> grads``) so the in-flight batch
+can be replayed on the restored state — without it, a rollback also drops
+the in-flight batch (the vetoed batch is dropped either way, exactly as
+in sync mode).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from torchft_tpu.ddp import allreduce_gradients
 from torchft_tpu.manager import Manager
@@ -30,7 +40,51 @@ from torchft_tpu.manager import Manager
 __all__ = ["ManagedOptimizer"]
 
 
-class ManagedOptimizer:
+class SpeculativeCommitMixin:
+    """Shared pipelined-commit snapshot plumbing (used by both
+    :class:`ManagedOptimizer` and
+    :class:`~torchft_tpu.parallel.ft.FTTrainer`).
+
+    The owner keeps its live pytrees in ``_params`` / ``_opt_state`` and
+    its manager in ``_manager``; this mixin owns the rollback snapshot,
+    the resolution callback, and the *sticky* replay flag — sticky so a
+    vote resolved out-of-band (e.g. a caller who pre-averages via
+    ``manager.allreduce`` must resolve first, because the manager refuses
+    collectives while a vote is pending) still gets its rollback handled
+    at the next ``step``."""
+
+    _snapshot: Optional[Tuple[Any, Any]] = None
+    _replay_needed = False
+    rollbacks = 0  # speculative steps undone by a veto
+
+    def _on_vote_resolved(self, committed: bool) -> None:
+        """Runs on the main thread inside ``resolve_pending_commit``,
+        before the speculation fence lifts — so the quorum thread can
+        never observe a half-rolled-back (state, step) pair."""
+        if not committed and self._snapshot is not None:
+            self._params, self._opt_state = self._snapshot
+            self.rollbacks += 1
+            self._replay_needed = True
+        self._snapshot = None
+
+    def _consume_replay(self) -> bool:
+        """True once per rollback: the current in-flight gradients were
+        computed on the rolled-back state and must be replayed/dropped."""
+        if self._replay_needed:
+            self._replay_needed = False
+            return True
+        return False
+
+    def finish(self) -> Optional[bool]:
+        """Resolve any outstanding speculative commit — call after the
+        last ``step`` of a pipelined run (idempotent; returns the final
+        vote, or None when nothing was outstanding)."""
+        if self._manager.pending_commit() is None:
+            return None
+        return self._manager.resolve_pending_commit(rearm=False)
+
+
+class ManagedOptimizer(SpeculativeCommitMixin):
     def __init__(self, manager: Manager, tx, register_state: bool = True) -> None:
         """``tx`` is an ``optax.GradientTransformation``. With
         ``register_state`` (default) ``init`` wires this wrapper's
@@ -44,6 +98,10 @@ class ManagedOptimizer:
         self._apply = None
         self._params: Optional[Any] = None
         self._opt_state: Optional[Any] = None
+        # pipelined commit (SpeculativeCommitMixin state)
+        self._snapshot = None
+        self._replay_needed = False
+        self.rollbacks = 0
 
     # -- state --
 
@@ -63,27 +121,71 @@ class ManagedOptimizer:
             self._manager.set_state_dict_fns(self.load_state_dict, self.state_dict)
 
     def state_dict(self) -> Dict[str, Any]:
+        snap = self._snapshot
+        if snap is not None:
+            # mid-speculation a peer must heal from COMMITTED state
+            return {"params": snap[0], "opt_state": snap[1]}
         return {"params": self._params, "opt_state": self._opt_state}
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self._params = state["params"]
         self._opt_state = state["opt_state"]
+        # a heal supersedes any speculative lineage — including a pending
+        # replay: gradients of the NEXT step are taken on this healed
+        # state, so they are valid, not vetoed-lineage leftovers
+        self._snapshot = None
+        self._replay_needed = False
 
     # -- step --
 
     def begin_step(self, allow_heal: bool = True, shrink_only: bool = False) -> None:
         """Start the (async) quorum — call before the forward pass so the
-        RPC overlaps compute (the reference hooks this into zero_grad)."""
+        RPC overlaps compute (the reference hooks this into zero_grad). In
+        pipelined mode the previous vote stays in flight here too: it
+        resolves inside the next ``step()``, so the caller's
+        value_and_grad is the compute that hides the vote RTT."""
         self._manager.start_quorum(allow_heal=allow_heal, shrink_only=shrink_only)
 
-    def step(self, grads: Any, average: bool = True) -> Any:
+    def step(
+        self,
+        grads: Any,
+        average: bool = True,
+        grad_fn: Optional[Callable[[Any], Any]] = None,
+    ) -> Any:
         """Average ``grads`` across replica groups, then apply the update
         iff the step commits. Returns the current params (healed and/or
         updated). Pass ``average=False`` if the gradients already went
-        through ``manager.allreduce``."""
-        if average:
-            grads = allreduce_gradients(self._manager, grads)
-        committed = self._manager.should_commit()
+        through ``manager.allreduce``. ``grad_fn`` (``params -> grads``,
+        pipelined mode only) recomputes the gradients after a rollback so
+        the in-flight batch is replayed instead of dropped."""
+        m = self._manager
+        if m.pending_commit() is not None:
+            # resolve the previous step's vote before this step's
+            # collectives/commit (at most one speculative step outstanding)
+            m.resolve_pending_commit()
+        if self._consume_replay():
+            # a rollback happened — here or out-of-band (an average=False
+            # caller resolves before its own manager.allreduce): ``grads``
+            # were computed on the rolled-back params
+            if grad_fn is None:
+                # cannot replay without the loss fn: drop this batch
+                # too (documented pipelined-mode caveat)
+                return self._params
+            # fresh grads always go through the managed average — any
+            # pre-averaging the caller did belongs to the vetoed lineage
+            grads = allreduce_gradients(m, grad_fn(self._params))
+        elif average:
+            grads = allreduce_gradients(m, grads)
+        if m.speculation_allowed():
+            # publish the snapshot before the speculative apply so a
+            # concurrent checkpoint serve never sees mid-update trees
+            self._snapshot = (self._params, self._opt_state)
+            self._params, self._opt_state = self._apply_update(
+                self._params, self._opt_state, grads
+            )
+            m.should_commit_async(on_resolved=self._on_vote_resolved)
+            return self._params
+        committed = m.should_commit()
         # should_commit may have healed: self._params now reflects the
         # recovered state; the gradient applied to it is the participants'
         # average (a healing replica contributed zeros)
@@ -94,16 +196,18 @@ class ManagedOptimizer:
         return self._params
 
     def _apply_update(self, params: Any, opt_state: Any, grads: Any):
+        # non-donating on purpose: the input pytrees double as the live
+        # recovery snapshot and, in pipelined mode, as the rollback
+        # snapshot — they must stay alive across the update
         if self._apply is None:
             import jax
             import optax
 
             tx = self._tx
 
-            @jax.jit
             def apply(params, opt_state, grads):
                 updates, new_state = tx.update(grads, opt_state, params)
                 return optax.apply_updates(params, updates), new_state
 
-            self._apply = apply
+            self._apply = jax.jit(apply)
         return self._apply(params, opt_state, grads)

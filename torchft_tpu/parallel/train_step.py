@@ -54,6 +54,11 @@ class TrainStep:
             return optax.apply_updates(params, updates), opt_state
 
         self._apply = jax.jit(apply_updates, donate_argnums=(0, 1))
+        # pipelined-commit variant, compiled lazily: the inputs must NOT
+        # be donated so the pre-update (params, opt_state) stays alive on
+        # device as the rollback snapshot (a reference, not a copy)
+        self._apply_updates_fn = apply_updates
+        self._apply_keep = None
 
         def fused(params, opt_state, tokens):
             loss, grads = jax.value_and_grad(compute_loss)(params, tokens)
@@ -100,7 +105,15 @@ class TrainStep:
         with jax.set_mesh(self.mesh):
             return self._value_and_grad(params, tokens)
 
-    def apply(self, params, opt_state, grads) -> Tuple[Any, Any]:
-        """Apply (possibly host-averaged) grads."""
+    def apply(self, params, opt_state, grads, donate: bool = True) -> Tuple[Any, Any]:
+        """Apply (possibly host-averaged) grads.
+
+        ``donate=False`` keeps the input buffers alive (at the cost of the
+        update not being in-place) — required when the caller retains the
+        pre-update trees as a pipelined-commit rollback snapshot."""
         with jax.set_mesh(self.mesh):
-            return self._apply(params, opt_state, grads)
+            if donate:
+                return self._apply(params, opt_state, grads)
+            if self._apply_keep is None:
+                self._apply_keep = jax.jit(self._apply_updates_fn)
+            return self._apply_keep(params, opt_state, grads)

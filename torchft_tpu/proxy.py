@@ -145,6 +145,9 @@ def _worker(factory, store_addr, rank, world_size, tx, rx) -> None:
                         buf.close()
                     except BufferError:
                         pass  # views freed with the op; mapping dies with us
+            elif name == "plane_info":
+                # metadata query, not an op: returns a plain string
+                result = backend.plane_info()
             else:
                 work = getattr(backend, name)(*args, **kwargs)
                 result = work.wait()
@@ -157,9 +160,13 @@ class CollectivesProxy(Collectives):
     """Run a Collectives backend in a kill-safe child process."""
 
     def plane_info(self) -> str:
-        # the inner backend lives in the child; label the isolation layer
-        # itself (querying the child per quorum isn't worth an RPC)
-        return "proxy"
+        # the inner backend lives in the child; report its live transport
+        # under the isolation-layer prefix (fetched once per configure —
+        # a silent CMA→TCP fallback must be visible on the dashboard, and
+        # the kill-safe proxy deployment is exactly where that label was
+        # being lost; ADVICE r5 #2)
+        inner = self._inner_plane
+        return f"proxy:{inner}" if inner else "proxy"
 
     def __init__(
         self,
@@ -181,6 +188,7 @@ class CollectivesProxy(Collectives):
         self._pending: Dict[int, Future] = {}
         self._lock = threading.Lock()
         self._drain: Optional[threading.Thread] = None
+        self._inner_plane = ""  # child backend's live plane label
 
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
         self.shutdown()
@@ -212,6 +220,21 @@ class CollectivesProxy(Collectives):
             target=self._drain_loop, args=(proc, rx, gen), daemon=True
         )
         self._drain.start()
+        # cache the child's live plane label once per epoch: configure is
+        # where a backend settles its transport (e.g. CMA probe fails →
+        # TCP), so one RPC here keeps plane_info() truthful and free
+        self._inner_plane = ""
+        try:
+            from torchft_tpu.futures import future_wait
+
+            self._inner_plane = str(
+                future_wait(
+                    self._submit("plane_info").get_future(),
+                    timedelta(seconds=5),
+                )
+            )
+        except Exception:  # noqa: BLE001 — label is best-effort cosmetics
+            pass
 
     def _drain_loop(self, proc, rx: MonitoredQueue, gen: int) -> None:
         while True:

@@ -54,6 +54,7 @@ CANONICAL_EVENTS = (
     "eviction",
     "commit",
     "abort",
+    "commit_rollback",
     "checkpoint_send",
     "checkpoint_recv",
     "step_outlier",
