@@ -8,6 +8,8 @@
 #include <cstdlib>
 #include <sstream>
 
+#include "faultinject.h"  // env-gated injection points (reply delay/drop)
+
 namespace tft {
 
 static int64_t wall_ms() {
@@ -1094,6 +1096,14 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
                    "16 quorum rounds; re-join with a fresh quorum call");
   }
   ManagerQuorumResult res = compute_quorum_results(replica_id_, rank, it->second);
+  // env-gated injection: hold the computed quorum reply (outside the
+  // lock — peer ranks' handlers must not stall behind the injected delay)
+  static const long fi_qd =
+      fi::parse_long("TORCHFT_FI_QUORUM_REPLY_DELAY_MS");
+  if (fi_qd > 0) {
+    lk.unlock();
+    fi::sleep_ms(fi_qd);
+  }
   return res.to_value();
 }
 
@@ -1136,7 +1146,24 @@ Value ManagerSrv::handle_should_commit(const Value& req, int64_t deadline) {
                    "commit window overrun: decision for this round was "
                    "trimmed; treat the step as failed and re-quorum");
   }
-  return Value::M().set("should_commit", Value::B(it->second));
+  const bool decision = it->second;
+  lk.unlock();
+  // env-gated injection on the vote DECISION path: delay the reply
+  // (commit-barrier RTT) or drop the nth one (a lost decision — the
+  // caller times out and must treat the step as failed)
+  static const long fi_cd =
+      fi::parse_long("TORCHFT_FI_COMMIT_REPLY_DELAY_MS");
+  if (fi_cd > 0) fi::sleep_ms(fi_cd);
+  static const long fi_drop = fi::parse_long("TORCHFT_FI_COMMIT_REPLY_DROP");
+  if (fi_drop > 0) {
+    static std::atomic<long> fi_replies{0};
+    long r = ++fi_replies;
+    if (r == fi_drop) {
+      fi::write_evidence("commit.vote", r, "drop");
+      throw RpcError(UNAVAILABLE, "fault injection: dropped commit reply");
+    }
+  }
+  return Value::M().set("should_commit", Value::B(decision));
 }
 
 // ---- KV store -------------------------------------------------------------

@@ -18,6 +18,7 @@
 #include <limits>
 #include <stdexcept>
 
+#include "faultinject.h"  // env-gated injection points (torn hops, kills)
 #include "rpc.h"  // tcp_listen / tcp_connect / listen_port / now_ms
 
 namespace tft {
@@ -171,6 +172,12 @@ bool recv_small(int fd, void* buf, size_t n, int64_t deadline_ms,
   }
   return true;
 }
+
+// process-wide hop counters for the env-gated injection points: the
+// schedule coordinate is "the nth hop this PROCESS runs", stable across
+// plane re-rendezvous (a per-plane counter would reset on every quorum)
+std::atomic<long> g_fi_hops{0};
+std::atomic<long> g_fi_cma_hops{0};
 
 }  // namespace
 
@@ -395,6 +402,38 @@ bool DataPlane::hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
                     uint8_t* rbuf, size_t rn, uint32_t tag,
                     int64_t deadline_ms, bool* send_failed, bool* timed_out,
                     std::string* err) {
+  // env-gated injection points (see faultinject.h): torn write / kill /
+  // delay on the nth hop this process runs. Zero-cost when disarmed.
+  static const fi::NthSpec fi_cut = fi::parse_nth("TORCHFT_FI_DP_CUT");
+  static const long fi_kill = fi::parse_long("TORCHFT_FI_DP_KILL");
+  static const long fi_delay = fi::parse_long("TORCHFT_FI_DP_DELAY_MS");
+  if (fi_cut.nth > 0 || fi_kill > 0 || fi_delay > 0) {
+    long h = ++g_fi_hops;
+    if (fi_delay > 0) fi::sleep_ms(fi_delay);
+    if (fi_kill > 0 && h == fi_kill) fi::kill_self("dp.hop", h);
+    if (fi_cut.nth > 0 && h == fi_cut.nth) {
+      // torn stripe write: full-length header, a fraction of the
+      // payload, then a hard cut — the peer must see a mid-frame EOF
+      // (its recv errors), never a short frame it could mistake for data
+      HopHdr thdr{tag, (uint32_t)sn};
+      bool to = false;
+      std::string e2;
+      size_t kbytes = (size_t)((double)sn * fi_cut.frac);
+      fi::write_evidence("dp.hop", h, "torn");
+      if (send_small(send_fd, &thdr, sizeof(thdr), deadline_ms, &to, &e2) &&
+          kbytes > 0) {
+        send_small(send_fd, sbuf, kbytes, deadline_ms, &to, &e2);
+      }
+      ::shutdown(send_fd, SHUT_RDWR);
+      *send_failed = true;
+      *timed_out = false;
+      *err = "fault injection: torn stripe write (hop " + std::to_string(h) +
+             ", " + std::to_string(kbytes) + "/" + std::to_string(sn) +
+             " bytes)";
+      return false;
+    }
+  }
+
   HopHdr shdr{tag, (uint32_t)sn};
   HopHdr rhdr{0, 0};
   size_t s_off = 0, r_off = 0;
@@ -516,10 +555,23 @@ bool DataPlane::cma_hop(int send_fd, int recv_fd, const uint8_t* sbuf,
                         bool* timed_out, std::string* err) {
   const int left = (rank_ - 1 + world_) % world_;
   *send_failed = false;
+  // env-gated injection points: die with a published pull descriptor
+  // outstanding (the torn-read window the ROADMAP divergence hypothesis
+  // names), or tear this hop's own pull partway.
+  static const long fi_cma_kill = fi::parse_long("TORCHFT_FI_CMA_KILL");
+  static const fi::NthSpec fi_cma_torn =
+      fi::parse_nth("TORCHFT_FI_CMA_TORN");
+  long fi_h = 0;
+  if (fi_cma_kill > 0 || fi_cma_torn.nth > 0) fi_h = ++g_fi_cma_hops;
   CmaDesc mine{tag, (uint32_t)sn, (uint64_t)(uintptr_t)sbuf};
   if (!send_small(send_fd, &mine, sizeof(mine), deadline_ms, timed_out, err)) {
     *send_failed = true;
     return false;
+  }
+  if (fi_cma_kill > 0 && fi_h == fi_cma_kill) {
+    // the right neighbor now holds {addr, len} into THIS address space;
+    // dying here is exactly "peer death mid-op with a dangling pull"
+    fi::kill_self("cma.desc", fi_h);
   }
   CmaDesc theirs{};
   if (!recv_small(recv_fd, &theirs, sizeof(theirs), deadline_ms, timed_out,
@@ -532,10 +584,17 @@ bool DataPlane::cma_hop(int send_fd, int recv_fd, const uint8_t* sbuf,
            std::to_string(rn);
     return false;
   }
+  size_t goal = rn;
+  if (fi_cma_torn.nth > 0 && fi_h == fi_cma_torn.nth) {
+    // torn CMA read: stop the pull partway and fail the hop — the
+    // partially-filled buffer must latch the step, never average in
+    goal = (size_t)((double)rn * fi_cma_torn.frac);
+    fi::write_evidence("cma.pull", fi_h, "torn");
+  }
   size_t off = 0;
-  while (off < rn) {
-    iovec lv{rbuf + off, rn - off};
-    iovec rv{(void*)(uintptr_t)(theirs.addr + off), rn - off};
+  while (off < goal) {
+    iovec lv{rbuf + off, goal - off};
+    iovec rv{(void*)(uintptr_t)(theirs.addr + off), goal - off};
     ssize_t k = ::process_vm_readv((pid_t)peer_pids_[left], &lv, 1, &rv, 1, 0);
     if (k <= 0) {
       *err = std::string("process_vm_readv: ") +
@@ -543,6 +602,11 @@ bool DataPlane::cma_hop(int send_fd, int recv_fd, const uint8_t* sbuf,
       return false;
     }
     off += (size_t)k;
+  }
+  if (goal < rn) {
+    *err = "fault injection: torn CMA pull (" + std::to_string(goal) + "/" +
+           std::to_string(rn) + " bytes)";
+    return false;
   }
   uint32_t ack = tag;
   if (!send_small(recv_fd, &ack, sizeof(ack), deadline_ms, timed_out, err)) {

@@ -1,0 +1,131 @@
+// Compiled-in, env-gated fault injection for the native data/control
+// plane — the C++ sibling of torchft_tpu/faultinject/core.py. Always
+// compiled (no build flag): a disarmed site costs one cached getenv and,
+// when any knob in its file is set, one relaxed atomic increment — the
+// hot path keeps its hooks in production builds so the exact binary that
+// ships can reproduce a failure.
+//
+// Knobs (parsed once per process, static at the call site):
+//
+//   TORCHFT_FI_DP_CUT=<nth>[:<frac>]   cut the <nth> stripe hop after
+//                                      sending <frac> (default 0.5) of
+//                                      the payload: a torn TCP write
+//                                      mid-allreduce — the receiver sees
+//                                      a mid-frame EOF, never short data
+//   TORCHFT_FI_DP_KILL=<nth>           SIGKILL this process entering the
+//                                      <nth> stripe hop (peer death
+//                                      mid-transfer)
+//   TORCHFT_FI_DP_DELAY_MS=<ms>        sleep before every stripe hop
+//   TORCHFT_FI_CMA_KILL=<nth>          SIGKILL right after publishing the
+//                                      <nth> CMA pull descriptor — the
+//                                      peer then holds a descriptor into
+//                                      dying memory, the exact window the
+//                                      torn-read divergence hypothesis
+//                                      needs
+//   TORCHFT_FI_CMA_TORN=<nth>[:<frac>] pull only <frac> of the <nth> CMA
+//                                      hop's bytes, then fail the hop
+//   TORCHFT_FI_RPC_CUT=<method>:<nth>  cut the client frame of the <nth>
+//                                      call to <method> mid-body (torn
+//                                      control-plane write)
+//   TORCHFT_FI_SRV_DELAY=<method>:<ms> delay every server reply to
+//                                      <method> by <ms> (quorum.reply /
+//                                      commit.vote latency injection at
+//                                      the native layer)
+//   TORCHFT_FI_COMMIT_REPLY_DROP=<nth> fail the <nth> mgr.should_commit
+//                                      reply with UNAVAILABLE (a lost
+//                                      vote decision)
+//
+// Fired kills append an evidence record under
+// TORCHFT_FAULT_EVIDENCE_DIR (same format the Python engine writes) so
+// the test tier can tell an injected death from the documented
+// environmental heap corruption.
+
+#ifndef TFT_FAULTINJECT_H_
+#define TFT_FAULTINJECT_H_
+
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+
+namespace tft {
+namespace fi {
+
+struct NthSpec {
+  long nth = 0;      // 0 = disarmed
+  double frac = 0.5;
+};
+
+inline NthSpec parse_nth(const char* env) {
+  NthSpec s;
+  const char* v = std::getenv(env);
+  if (!v || !*v) return s;
+  s.nth = std::atol(v);
+  const char* c = std::strchr(v, ':');
+  if (c) s.frac = std::atof(c + 1);
+  return s;
+}
+
+inline long parse_long(const char* env) {
+  const char* v = std::getenv(env);
+  return (v && *v) ? std::atol(v) : 0;
+}
+
+struct MethodSpec {
+  std::string method;  // empty = disarmed
+  long n = 0;          // nth for CUT, ms for DELAY
+};
+
+inline MethodSpec parse_method(const char* env) {
+  MethodSpec s;
+  const char* v = std::getenv(env);
+  if (!v || !*v) return s;
+  const char* c = std::strrchr(v, ':');
+  if (!c) return s;
+  s.method.assign(v, c - v);
+  s.n = std::atol(c + 1);
+  return s;
+}
+
+inline void sleep_ms(long ms) {
+  std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+}
+
+// Evidence record, same directory + JSONL shape as the Python engine's
+// FaultPlane._write_evidence — conftest's injection-evidence check and
+// the scenario runner read both interchangeably.
+inline void write_evidence(const char* site, long hit, const char* action) {
+  const char* dir = std::getenv("TORCHFT_FAULT_EVIDENCE_DIR");
+  if (!dir || !*dir) return;
+  char path[512];
+  std::snprintf(path, sizeof(path), "%s/tft_fault_%d_native.json", dir,
+                (int)getpid());
+  FILE* f = std::fopen(path, "a");
+  if (!f) return;
+  std::fprintf(f,
+               "{\"site\": \"%s\", \"action\": \"%s\", \"hit\": %ld, "
+               "\"pid\": %d, \"native\": true}\n",
+               site, action, hit, (int)getpid());
+  std::fflush(f);
+  ::fsync(fileno(f));
+  std::fclose(f);
+}
+
+inline void kill_self(const char* site, long hit) {
+  write_evidence(site, hit, "kill");
+  std::fprintf(stderr, "fault injection: SIGKILL at %s hit %ld (pid %d)\n",
+               site, hit, (int)getpid());
+  std::fflush(stderr);
+  ::raise(SIGKILL);
+}
+
+}  // namespace fi
+}  // namespace tft
+
+#endif  // TFT_FAULTINJECT_H_

@@ -14,6 +14,8 @@
 #include <cstring>
 #include <random>
 
+#include "faultinject.h"  // env-gated injection points (torn frames, delays)
+
 namespace tft {
 
 int64_t now_ms() {
@@ -353,6 +355,12 @@ void RpcServer::serve_conn(int fd) {
       std::string method = req.gets("_m");
       int64_t timeout_ms = req.geti("_d", 60000);
       int64_t deadline = now_ms() + timeout_ms;
+      // env-gated injection: stretch this method's server-side handling
+      // (e.g. TORCHFT_FI_SRV_DELAY=mgr.should_commit:200 is a commit-vote
+      // RTT the pipelined mode must hide)
+      static const fi::MethodSpec fi_dly =
+          fi::parse_method("TORCHFT_FI_SRV_DELAY");
+      if (fi_dly.n > 0 && method == fi_dly.method) fi::sleep_ms(fi_dly.n);
       resp = handler_(method, req, deadline);
       if (resp.type != Value::Type::MAP) resp = Value::M();
       resp.set("_s", Value::I(OK));
@@ -442,6 +450,23 @@ Value RpcClient::call(const std::string& method, Value req, int64_t timeout_ms) 
                        (uint8_t)((body.size() >> 8) & 0xff),
                        (uint8_t)((body.size() >> 16) & 0xff),
                        (uint8_t)((body.size() >> 24) & 0xff)};
+  // env-gated injection: cut the nth call to <method> mid-body — a torn
+  // control-plane frame (the server must drop the desynced stream, the
+  // caller sees UNAVAILABLE and retries on a fresh connection)
+  static const fi::MethodSpec fi_cut = fi::parse_method("TORCHFT_FI_RPC_CUT");
+  if (fi_cut.n > 0 && method == fi_cut.method) {
+    static std::atomic<long> fi_calls{0};
+    long c = ++fi_calls;
+    if (c == fi_cut.n) {
+      fi::write_evidence("rpc.send", c, "torn");
+      write_all(fd_, lenbuf, 4);
+      write_all(fd_, body.data(), body.size() / 2);
+      ::shutdown(fd_, SHUT_RDWR);
+      disconnect();
+      throw RpcError(UNAVAILABLE,
+                     "fault injection: torn rpc frame for " + method);
+    }
+  }
   if (!write_all(fd_, lenbuf, 4) || !write_all(fd_, body.data(), body.size())) {
     disconnect();
     throw RpcError(UNAVAILABLE, "send to " + addr_ + " failed");

@@ -44,48 +44,62 @@ def scaled_timeout(seconds: float) -> float:
     return seconds * SUBPROC_TIMEOUT_SCALE
 
 
-# Known pre-existing native corruption signatures (ROADMAP open item,
-# PR 2 post-mortem): a worker process on this box can die of heap
-# corruption (glibc aborts) or its pytree-level symptom ("Too few
-# elements for TreeDef node") during multi-process churn, on UNMODIFIED
-# checkouts too. Multi-process soaks skip — not fail — when a failure
-# carries one of these signatures, so red means NEW bug, not the
-# documented environmental one. Anything else still fails loudly.
-KNOWN_NATIVE_CORRUPTION_SIGNATURES = (
-    "Too few elements for TreeDef node",
-    "malloc(): ",
-    "malloc_consolidate",
-    "double free or corruption",
-    "free(): invalid",
-    "corrupted size vs. prev_size",
-    "corrupted double-linked list",
-    "Segmentation fault",
-)
+# The environmental-corruption catalog (ROADMAP open item, PR 2
+# post-mortem) lives in torchft_tpu/faultinject/core.py so the scenario
+# runner and this test tier recognize the same signatures; multi-process
+# soaks skip — not fail — on them, so red means NEW bug, not the
+# documented one. Imported lazily: conftest must not pull the package
+# (and its native auto-build) in before the env fixtures run.
 
 
 def known_corruption_signature(text: str):
     """Return the matched known-corruption signature in ``text``, or None."""
-    for sig in KNOWN_NATIVE_CORRUPTION_SIGNATURES:
+    from torchft_tpu.faultinject.core import ENV_CORRUPTION_SIGNATURES
+
+    for sig in ENV_CORRUPTION_SIGNATURES:
         if sig in text:
             return sig
     return None
 
 
-# signal-class deaths that glibc/the kernel may leave without any log
-# output: SIGSEGV, SIGABRT, SIGBUS
-_CORRUPTION_SIGNAL_RCS = (-11, -6, -7)
+def injected_kill_evidence(evidence_dir=None):
+    """Fired kill/torn records from the fault-injection plane's evidence
+    files (``TORCHFT_FAULT_EVIDENCE_DIR``). A worker that died because a
+    SCHEDULED injection killed it writes this record before dying — both
+    the Python engine (faultinject/core.py) and the native plane
+    (native/faultinject.h) use the same directory and JSONL shape."""
+    from torchft_tpu.faultinject.core import read_evidence
+
+    return [
+        r
+        for r in read_evidence(evidence_dir)
+        if r.get("action") in ("kill", "torn", "drop")
+    ]
 
 
-def skip_if_known_corruption(text: str, rcs=(), nan_checksums: bool = False):
+def skip_if_known_corruption(
+    text: str, rcs=(), nan_checksums: bool = False, evidence_dir=None
+):
     """One policy for every multi-process soak: ``pytest.skip`` when a
     failure carries the documented pre-existing corruption evidence — a
     known signature in ``text``, a signal-class return code in ``rcs``,
     or (opt-in) the all-nan-checksum divergence form. Returns normally
-    when the failure looks like a NEW bug, so the caller re-raises."""
+    when the failure looks like a NEW bug, so the caller re-raises.
+
+    Injection evidence WINS over a signature match: a worker killed by a
+    scheduled fault-injection (SIGKILL shows up as rc -9/-6-class noise
+    and can segfault jit mid-step, mimicking the environmental signature)
+    must never be laundered into a skip — the test scheduled that death
+    and must handle or fail it explicitly."""
     import pytest
 
+    from torchft_tpu.faultinject.core import CORRUPTION_SIGNAL_RCS
+
+    if injected_kill_evidence(evidence_dir):
+        return
+
     sig = known_corruption_signature(text)
-    if sig is None and any(rc in _CORRUPTION_SIGNAL_RCS for rc in rcs):
+    if sig is None and any(rc in CORRUPTION_SIGNAL_RCS for rc in rcs):
         sig = f"signal rc in {sorted(set(rcs))}"
     if sig is None and nan_checksums and "param_checksum=nan" in text:
         # the divergence mode of the same corruption: no crash, but the

@@ -4,7 +4,14 @@ mid-training; the cohort must keep making progress and end bit-identical.
 This is the real-subprocess escalation of the reference's torchelastic
 restart emulation (manager_integ_test.py attempts=3, in-thread): three
 full process kills, disk resume + live heal each time, no step skipped or
-double-trained (trace-verified like tests/test_data_example.py)."""
+double-trained (trace-verified like tests/test_data_example.py).
+
+These soaks race wall clocks; for DETERMINISTIC failure placement (kill a
+peer mid-allreduce on a chosen plane, tear a CMA pull at a chosen byte,
+delay a chosen commit vote) use the seeded fault-injection plane instead:
+``torchft_tpu/faultinject/`` + ``pytest -m faultmatrix`` +
+``python -m torchft_tpu.faultinject.runner`` — see
+``docs/fault_injection.md``."""
 
 import json
 import os

@@ -15,7 +15,12 @@ from typing import Any, Dict, Tuple
 from torchft_tpu.utils import wire
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_HERE, "libtftcore.so")
+# TORCHFT_NATIVE_LIB points the loader at an alternate build of the core —
+# the sanitizer runs load libtftcore_asan.so/_ubsan.so this way (built by
+# `make -C native asan|ubsan`; the ASan runtime must also be LD_PRELOADed
+# since the interpreter itself is uninstrumented).
+_LIB_OVERRIDE = os.environ.get("TORCHFT_NATIVE_LIB")
+_LIB_PATH = _LIB_OVERRIDE or os.path.join(_HERE, "libtftcore.so")
 _NATIVE_SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "native"))
 
 # RPC status codes (native/wire.h). CANCELLED and DEADLINE_EXCEEDED map to
@@ -54,6 +59,11 @@ def _build() -> None:
 
 def _load() -> ctypes.CDLL:
     if not os.path.exists(_LIB_PATH):
+        if _LIB_OVERRIDE:
+            raise RuntimeError(
+                f"TORCHFT_NATIVE_LIB={_LIB_OVERRIDE} does not exist; build "
+                "it first (e.g. `make -C native asan`)"
+            )
         _build()
     lib = ctypes.CDLL(_LIB_PATH)
 

@@ -164,6 +164,23 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
                     else:
                         self.send_error(404, f"bad path {self.path}")
                         return
+                    from torchft_tpu.faultinject.core import fault_point
+
+                    inj = fault_point(
+                        "ckpt.serve", match=what, wire=True, step=step,
+                        nbytes=sum(len(p) for p in payload),
+                    )
+                    if inj is not None and inj.action in ("drop", "torn"):
+                        # checkpoint-serve death mid-heal: promise the
+                        # full Content-Length, stream only a prefix, then
+                        # cut the connection — the healer must fail the
+                        # transfer (short read), never stage the torn
+                        # state; it retries on its next quorum
+                        self._serve_torn(
+                            payload,
+                            inj.frac if inj.action == "torn" else 0.0,
+                        )
+                        return
                     self.send_response(200)
                     nbytes = sum(len(p) for p in payload)
                     self.send_header("Content-Type", "application/octet-stream")
@@ -202,6 +219,30 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
                         pass
                 finally:
                     transport._lock.r_release()
+
+            def _serve_torn(self, payload, frac: float) -> None:
+                nbytes = sum(len(p) for p in payload)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(nbytes))
+                self.end_headers()
+                budget = int(nbytes * frac)
+                try:
+                    for part in payload:
+                        if budget <= 0:
+                            break
+                        chunk = part[:budget]
+                        self.wfile.write(chunk)
+                        budget -= len(chunk)
+                    self.wfile.flush()
+                finally:
+                    # hard-cut so the client sees EOF mid-body, exactly
+                    # like the serving process dying mid-transfer
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.close_connection = True
 
         self._server = _Server(("::", 0), Handler)
         self._port = self._server.server_address[1]
@@ -292,6 +333,9 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
     def recv_checkpoint(
         self, src_rank: int, metadata: str, step: int, timeout: timedelta
     ) -> T:
+        from torchft_tpu.faultinject.core import fault_point
+
+        fault_point("ckpt.recv", match=str(step), step=step)
         base = f"{metadata}/checkpoint/{step}"
         secs = timeout.total_seconds()
         if self._num_chunks == 0:

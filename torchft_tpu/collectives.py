@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from torchft_tpu.faultinject.core import fault_point
 from torchft_tpu.futures import Future
 from torchft_tpu.store import create_store_client
 
@@ -246,6 +247,20 @@ def _cma_pull(pid: int, addr: int, view: memoryview) -> None:
 
     from torchft_tpu._native import cma_read_into
 
+    inj = fault_point("cma.pull", match=f"pid{pid}", wire=True,
+                      nbytes=len(view))
+    if inj is not None and inj.action in ("torn", "drop"):
+        # torn read: fill only a prefix of the caller's buffer (what a
+        # pull from a peer dying mid-op would leave behind), then fail
+        # the stream loudly so the step latches instead of committing
+        # the partial bytes
+        k = int(len(view) * inj.frac) if inj.action == "torn" else 0
+        if k:
+            cma_read_into(pid, addr, view[:k])
+        raise ConnectionError(
+            f"fault injection: torn CMA pull ({k}/{len(view)} bytes "
+            f"from pid {pid})"
+        )
     try:
         cma_read_into(pid, addr, view)
     except OSError as e:
@@ -775,7 +790,7 @@ class CollectivesTcp(Collectives):
             raise RuntimeError(f"no connection to peer {rank}")
         return p
 
-    def _submit(self, fn: Callable, p2p: bool = False) -> Work:
+    def _submit(self, fn: Callable, p2p: bool = False, op: str = "") -> Work:
         """Run ``fn`` async. Collective ops share ONE ordered thread (SPMD
         tag sequencing + natural per-bucket pipelining); point-to-point ops
         go to the p2p pool so transfers to/from different peers — and
@@ -787,7 +802,16 @@ class CollectivesTcp(Collectives):
 
         def run() -> None:
             try:
-                out.set_result(fn())
+                result = fn()
+                if op:
+                    # completion-side injection site: a delay here holds
+                    # the op thread (stalling the ring like a wedged
+                    # peer); an error fails the finished op before its
+                    # future resolves
+                    fault_point(
+                        "collective.complete", match=op, rank=self._rank
+                    )
+                out.set_result(result)
             except BaseException as e:  # noqa: BLE001 — propagate via future
                 out.set_exception(e)
 
@@ -809,6 +833,15 @@ class CollectivesTcp(Collectives):
         return Work(out)
 
     def _send_to(self, rank: int, tag: int, data: memoryview) -> None:
+        inj = fault_point(
+            "rpc.send", match=f"peer{rank}", wire=True,
+            tag=tag, nbytes=len(data), rank=self._rank,
+        )
+        if inj is not None:
+            if inj.action == "drop":
+                return  # silently unsent: the peer's recv hits its deadline
+            if inj.action == "torn":
+                self._torn_send(rank, tag, data, inj.frac)  # raises
         if (
             self._dp_cma_pids is not None
             and len(data) >= self._cma_p2p_min
@@ -824,6 +857,31 @@ class CollectivesTcp(Collectives):
             if isinstance(e, (socket.timeout, TimeoutError)):
                 raise  # slow-but-alive peer: latch the error, don't accuse
             raise PeerGoneError(rank, f"send to peer {rank} failed: {e}") from e
+
+    def _torn_send(self, rank: int, tag: int, data: memoryview,
+                   frac: float) -> None:
+        """Fault-injection wire primitive: frame a FULL-length header,
+        ship only ``frac`` of the payload, then hard-cut the socket —
+        exactly what a peer dying mid-send leaves on the wire. The
+        receiver must surface a mid-frame EOF (never half-filled data
+        reported as success); this side latches like any dead-peer send."""
+        p = self._peer(rank)
+        k = int(len(data) * frac)
+        try:
+            with p.send_lock:
+                p.sock.sendall(_FRAME_HDR.pack(tag, len(data)))
+                if k:
+                    p.sock.sendall(data[:k])
+        finally:
+            try:
+                p.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        raise PeerGoneError(
+            rank,
+            f"fault injection: torn send to peer {rank} "
+            f"({k}/{len(data)} bytes)",
+        )
 
     def _send_cma(self, rank: int, tag: int, data: memoryview) -> None:
         """Ship a pull descriptor instead of the payload; the buffer must
@@ -883,6 +941,9 @@ class CollectivesTcp(Collectives):
         returned."""
         p = self._peer(rank)
         try:
+            fault_point(
+                "rpc.recv", match=f"peer{rank}", tag=tag, rank=self._rank,
+            )
             return self._recv_matched(p, rank, tag, into)
         except (ConnectionError, OSError) as e:
             if isinstance(e, (socket.timeout, TimeoutError)):
@@ -1071,6 +1132,10 @@ class CollectivesTcp(Collectives):
         returns the flight sequence id for completion marking."""
         from torchft_tpu import telemetry
 
+        fault_point(
+            "collective.issue", match=op_name,
+            nbytes=nbytes, tag=tag, rank=self._rank,
+        )
         plane = self.plane_info()
         telemetry.COLLECTIVE_OPS.labels(op=op_name, plane=plane).inc()
         return telemetry.FLIGHT.record_issue(
@@ -1119,7 +1184,7 @@ class CollectivesTcp(Collectives):
             )
             return arrays
 
-        return self._track_flight(self._submit(run), fid)
+        return self._track_flight(self._submit(run, op="allreduce"), fid)
 
     def _dp_eligible(self, arr: np.ndarray) -> bool:
         # wire_dtype other than bfloat16 isn't implemented natively; such
@@ -1231,7 +1296,7 @@ class CollectivesTcp(Collectives):
                     out[cur_idx] = cur
             return out  # type: ignore[return-value]
 
-        return self._track_flight(self._submit(run), fid)
+        return self._track_flight(self._submit(run, op="allgather"), fid)
 
     def broadcast(self, arr: np.ndarray, root: int = 0) -> Work:
         world, rank = self._world, self._rank
@@ -1250,7 +1315,7 @@ class CollectivesTcp(Collectives):
                     _flat_view(arr)[:] = np.frombuffer(data, dtype=arr.dtype)
             return arr
 
-        return self._track_flight(self._submit(run), fid)
+        return self._track_flight(self._submit(run, op="broadcast"), fid)
 
     def reduce_scatter(
         self, arrays: List[np.ndarray], op: ReduceOp = ReduceOp.SUM
@@ -1290,7 +1355,7 @@ class CollectivesTcp(Collectives):
                 np.divide(acc, world, out=acc)
             return acc
 
-        return self._track_flight(self._submit(run), fid)
+        return self._track_flight(self._submit(run, op="reduce_scatter"), fid)
 
     def alltoall(self, arrays: List[np.ndarray]) -> Work:
         world, rank = self._world, self._rank
@@ -1318,7 +1383,7 @@ class CollectivesTcp(Collectives):
                 )
             return out  # type: ignore[return-value]
 
-        return self._track_flight(self._submit(run), fid)
+        return self._track_flight(self._submit(run, op="alltoall"), fid)
 
     def send(self, arr: np.ndarray, dst: int, tag: int = 0) -> Work:
         wire_tag = 0x06000000 | (tag & 0xFFFFFF)
@@ -1327,7 +1392,7 @@ class CollectivesTcp(Collectives):
         def run() -> None:
             self._send_to(dst, wire_tag, _bytes_view(arr))
 
-        return self._track_flight(self._submit(run, p2p=True), fid)
+        return self._track_flight(self._submit(run, p2p=True, op="send"), fid)
 
     def recv(self, arr: np.ndarray, src: int, tag: int = 0) -> Work:
         wire_tag = 0x06000000 | (tag & 0xFFFFFF)
@@ -1339,7 +1404,7 @@ class CollectivesTcp(Collectives):
             assert done is None, "into-receive must fill in place"
             return arr
 
-        return self._track_flight(self._submit(run, p2p=True), fid)
+        return self._track_flight(self._submit(run, p2p=True, op="recv"), fid)
 
     def barrier(self) -> Work:
         token = np.zeros(1, dtype=np.int32)
@@ -1351,7 +1416,7 @@ class CollectivesTcp(Collectives):
             if world > 1:
                 self._ring_allreduce(token, ReduceOp.SUM, tag)
 
-        return self._track_flight(self._submit(run), fid)
+        return self._track_flight(self._submit(run, op="barrier"), fid)
 
 
 # ---------------------------------------------------------------------------

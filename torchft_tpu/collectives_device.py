@@ -268,7 +268,11 @@ class CollectivesDevice(Collectives):
         """Deposit this group's input for the next SPMD op slot; the last
         group to arrive computes and resolves everyone's future."""
         from torchft_tpu import telemetry
+        from torchft_tpu.faultinject.core import fault_point
 
+        fault_point(
+            "collective.issue", match=f"device.{kind}", rank=self._rank
+        )
         ep = self._epoch
         assert ep is not None, "configure() must be called first"
         if kind != "allreduce":  # allreduce accounts bytes+latency itself

@@ -215,6 +215,12 @@ class ManagerClient:
         # this duration IS quorum-formation latency as this rank saw it
         telemetry.QUORUM_LATENCY.observe(time.perf_counter() - t0)
         telemetry.QUORUMS_TOTAL.inc()
+        # reply-side injection: a delay here stretches the window between
+        # the quorum landing and the plane reconfigure; an error makes
+        # this rank treat a DELIVERED quorum as failed (retried next step)
+        from torchft_tpu.faultinject.core import fault_point
+
+        fault_point("quorum.reply", match="", rank=rank, step=step)
         return QuorumResult._from_wire(resp)
 
     def _checkpoint_metadata(self, rank: int, timeout: timedelta) -> str:
@@ -231,7 +237,13 @@ class ManagerClient:
         timeout: timedelta,
     ) -> bool:
         from torchft_tpu import telemetry
+        from torchft_tpu.faultinject.core import fault_point
 
+        # vote-RPC injection: `delay` is the synthetic commit-barrier RTT
+        # (what the pipelined mode must hide), `error` a lost vote
+        fault_point(
+            "commit.vote", match="rpc", rank=rank, step=step,
+        )
         with telemetry.TRACER.span(
             "should_commit_rpc", rank=rank, step=step, vote=should_commit
         ):

@@ -1,0 +1,111 @@
+"""Jax-free scenario worker for the sanitized fault matrix.
+
+The ASan runtime and jaxlib cannot coexist in one process: ASan's
+``__cxa_throw`` interceptor CHECK-fails inside jaxlib's MLIR bindings
+during the very first jit trace, killing the worker before a scenario
+even starts (and the interpreter is uninstrumented, so nothing useful is
+reported). The heap-corruption suspects named by the ROADMAP open item —
+the native data plane, the RPC layer, and the CMA pull path — are all
+fully exercised by a numpy-only trainer, so ``--sanitize`` runs drive
+THIS worker instead of ``examples/train_bytes.py``: the same
+Manager / CollectivesTcp / quorum / heal / commit path, minus the jit'd
+model.
+
+Same launcher env contract as the example (``REPLICA_GROUP_ID``,
+``NUM_REPLICA_GROUPS``, ``STEPS``, ``TORCHFT_LIGHTHOUSE``) and the same
+final ``param_checksum=%.6f`` line the runner's cross-group invariant
+check greps. Gradients are a pure function of ``(group, step)`` so a
+retried, healed, or respawned step regenerates identical bytes — the
+bit-identity assertion holds through any injection the schedule fires.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+from datetime import timedelta
+
+import numpy as np
+
+logging.basicConfig(
+    level=logging.INFO, format="%(asctime)s %(name)s: %(message)s"
+)
+logger = logging.getLogger("san_worker")
+
+assert "jax" not in sys.modules, (
+    "the sanitize worker must stay jax-free (ASan's __cxa_throw "
+    "interceptor aborts inside jaxlib's jit tracing)"
+)
+
+SHAPE = (256, 256)  # 256 KiB of f32: large enough for striped/CMA hops
+
+
+def main() -> None:
+    from torchft_tpu.collectives import CollectivesTcp
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.store import StoreServer
+
+    gid = int(os.environ["REPLICA_GROUP_ID"])
+    num_groups = int(os.environ.get("NUM_REPLICA_GROUPS", "2"))
+    steps = int(os.environ.get("STEPS", "10"))
+
+    params = {"w": np.zeros(SHAPE, np.float32), "steps_seen": 0}
+
+    def state_dict():
+        return {"w": params["w"].copy(), "steps_seen": params["steps_seen"]}
+
+    def load_state_dict(state) -> None:
+        params["w"] = np.asarray(state["w"], np.float32).copy()
+        params["steps_seen"] = int(state["steps_seen"])
+
+    store = StoreServer()
+    manager = Manager(
+        collectives=CollectivesTcp(timeout=timedelta(seconds=30)),
+        load_state_dict=load_state_dict,
+        state_dict=state_dict,
+        min_replica_size=min(2, num_groups),
+        replica_id=f"san_worker_{gid}",
+        store_addr=store.address(),
+        rank=0,
+        world_size=1,
+        timeout=timedelta(seconds=30),
+    )
+    logger.info("start: gid=%d pid=%d steps=%d", gid, os.getpid(), steps)
+    try:
+        while manager.current_step() < steps:
+            step = manager.current_step()
+            try:
+                manager.start_quorum()
+                # pure function of (gid, step): retries and respawns
+                # regenerate identical bytes, so every COMMITTED step's
+                # average — and therefore the final checksum — is
+                # bit-identical across groups
+                rng = np.random.default_rng((gid << 24) ^ step)
+                grad = rng.standard_normal(SHAPE).astype(np.float32)
+                manager.allreduce(grad).wait()
+                committed = manager.should_commit()
+            except TimeoutError as e:
+                # a quorum/op deadline blown while a peer is down is a
+                # retry, not a crash (the runner's own deadline still
+                # bounds a true wedge)
+                logger.info("timeout, retrying step %d: %s", step, e)
+                continue
+            if committed:
+                params["w"] -= 0.01 * grad
+                params["steps_seen"] += 1
+            else:
+                time.sleep(0.2)  # same step retries: it didn't advance
+        checksum = float(np.asarray(params["w"], np.float64).sum())
+        logger.info(
+            "done: step=%d param_checksum=%.6f",
+            manager.current_step(), checksum,
+        )
+    finally:
+        manager.shutdown(wait=False)
+        store.shutdown()
+
+
+if __name__ == "__main__":
+    main()

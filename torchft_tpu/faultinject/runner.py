@@ -1,0 +1,562 @@
+"""Fault-injection scenario runner — drives the 2-group example trainer
+through a deterministic failure matrix and asserts the end-to-end safety
+invariant:
+
+    **no committed step may carry corrupt averages** — survivor parameter
+    checksums stay finite and bit-identical across groups, or the step
+    must abort/veto/heal instead of committing.
+
+Scenarios (each = one 2-group ``examples/train_bytes.py`` run with a
+seeded schedule and/or native env knobs on a designated victim):
+
+* ``kill_allreduce_{cma,tcp,python}`` — the victim dies MID-allreduce on
+  each data plane the host path can select (CMA descriptor window /
+  striped-TCP hop / python-ring frame send); the runner respawns it and
+  the cohort must converge bit-identical.
+* ``torn_stripe_tcp`` — a stripe's TCP frame is cut halfway (torn write);
+  the victim survives, the step must latch + flush-re-quorum.
+* ``torn_cma_pull`` — a CMA pull stops partway (torn read, the ROADMAP
+  divergence hypothesis); the partial buffer must never average in.
+* ``commit_vote_delay_pipeline`` — every 3rd should_commit vote delayed
+  under ``TORCHFT_COMMIT_PIPELINE=1`` (the speculation fence must hold).
+* ``ckpt_serve_death`` — the victim is killed, and the survivor's first
+  checkpoint serve to the healer is cut mid-stream; the heal must retry,
+  never stage torn state.
+
+Workers that die WITH injection evidence (``TORCHFT_FAULT_EVIDENCE_DIR``)
+are the scenario — they are respawned. A worker death carrying the
+documented environmental-corruption signature but NO evidence marks the
+scenario ``environmental`` (recorded, not a failure — see ROADMAP open
+item). Anything else fails the run.
+
+``--sanitize`` rebuilds the native plane under ASan (``make -C native
+asan``), runs a short matrix with the sanitized core LD_PRELOAD-loaded
+into every worker, and fails on any sanitizer report — the repeatable
+form of the ROADMAP's heap-corruption hunt.
+
+Usage::
+
+    python -m torchft_tpu.faultinject.runner --quick
+    python -m torchft_tpu.faultinject.runner --scenario torn_cma_pull
+    make -C native asan && \
+        python -m torchft_tpu.faultinject.runner --sanitize --quick
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+REPO = os.path.normpath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+_EXAMPLE = os.path.join(REPO, "examples", "train_bytes.py")
+
+# environmental-corruption catalog — shared with tests/conftest.py via
+# the package (running `-m torchft_tpu.faultinject.runner` imports the
+# parent package anyway, so this adds no import cost)
+from torchft_tpu.faultinject.core import (  # noqa: E402
+    CORRUPTION_SIGNAL_RCS,
+    ENV_CORRUPTION_SIGNATURES,
+    read_evidence,
+)
+
+
+@dataclass
+class Scenario:
+    name: str
+    description: str
+    victim_env: Dict[str, str] = field(default_factory=dict)
+    survivor_env: Dict[str, str] = field(default_factory=dict)
+    common_env: Dict[str, str] = field(default_factory=dict)
+    victim_schedule: Optional[dict] = None
+    survivor_schedule: Optional[dict] = None
+    expect_victim_death: bool = False
+    quick: bool = True  # include in the --quick / --sanitize subset
+
+
+SCENARIOS: List[Scenario] = [
+    Scenario(
+        name="kill_allreduce_cma",
+        description="victim SIGKILLed after publishing a CMA pull "
+        "descriptor (peer holds a descriptor into dying memory)",
+        victim_env={"TORCHFT_FI_CMA_KILL": "3"},
+        expect_victim_death=True,
+    ),
+    Scenario(
+        name="kill_allreduce_tcp",
+        description="victim SIGKILLed entering a striped-TCP hop "
+        "mid-allreduce",
+        common_env={"TORCHFT_DP_CMA": "0"},
+        victim_env={"TORCHFT_FI_DP_KILL": "3"},
+        expect_victim_death=True,
+        quick=False,
+    ),
+    Scenario(
+        name="kill_allreduce_python",
+        description="victim SIGKILLed mid-frame-send on the python-ring "
+        "plane",
+        common_env={"TORCHFT_NATIVE_PLANE": "0"},
+        victim_schedule={
+            "seed": 1,
+            "rules": [
+                {"site": "rpc.send", "nth": 4, "action": "kill", "sig": 9}
+            ],
+        },
+        expect_victim_death=True,
+        quick=False,
+    ),
+    Scenario(
+        name="torn_stripe_tcp",
+        description="a striped-TCP hop is cut after half the payload "
+        "(torn write); step must latch + flush, victim survives",
+        common_env={"TORCHFT_DP_CMA": "0"},
+        victim_env={"TORCHFT_FI_DP_CUT": "3:0.5"},
+    ),
+    Scenario(
+        name="torn_cma_pull",
+        description="a CMA pull stops halfway (torn read — the ROADMAP "
+        "checksum-divergence hypothesis); partial bytes must never "
+        "average into a committed step",
+        victim_env={"TORCHFT_FI_CMA_TORN": "3:0.5"},
+    ),
+    Scenario(
+        name="commit_vote_delay_pipeline",
+        description="every 3rd commit vote delayed 150ms under the "
+        "pipelined commit mode",
+        common_env={"TORCHFT_COMMIT_PIPELINE": "1"},
+        victim_schedule={
+            "seed": 2,
+            "rules": [
+                {
+                    "site": "commit.vote",
+                    "match": "rpc",
+                    "every": 3,
+                    "action": "delay",
+                    "ms": 150,
+                }
+            ],
+        },
+        quick=False,
+    ),
+    Scenario(
+        name="ckpt_serve_death",
+        description="victim killed mid-run; the survivor's first "
+        "checkpoint serve to the healer is cut mid-stream (serve death "
+        "mid-heal) — the heal must retry, never stage torn state",
+        victim_schedule={
+            "seed": 3,
+            "rules": [
+                {
+                    "site": "collective.issue",
+                    "match": "allreduce",
+                    "nth": 6,
+                    "action": "kill",
+                    "sig": 9,
+                }
+            ],
+        },
+        survivor_schedule={
+            "seed": 3,
+            "rules": [{"site": "ckpt.serve", "nth": 1, "action": "drop"}],
+        },
+        expect_victim_death=True,
+    ),
+]
+
+
+@dataclass
+class Result:
+    scenario: str
+    status: str  # passed | environmental | failed
+    detail: str = ""
+    fired: int = 0
+    respawns: int = 0
+    checksums: Optional[List[str]] = None
+
+
+def _env_signature(text: str) -> Optional[str]:
+    for sig in ENV_CORRUPTION_SIGNATURES:
+        if sig in text:
+            return sig
+    return None
+
+
+def _spawn(gid: int, lighthouse_addr: str, workdir: str, steps: int,
+           env_extra: Dict[str, str],
+           argv: Optional[List[str]] = None) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.update(
+        REPLICA_GROUP_ID=str(gid),
+        NUM_REPLICA_GROUPS="2",
+        STEPS=str(steps),
+        BATCH="4",
+        DATA_PATH=os.path.join(workdir, "corpus.bin"),
+        TRACE_PATH=os.path.join(workdir, f"trace{gid}.jsonl"),
+        TORCHFT_LIGHTHOUSE=lighthouse_addr,
+        JAX_PLATFORMS="cpu",
+        TORCHFT_FAULT_EVIDENCE_DIR=os.path.join(workdir, "evidence"),
+        TORCHFT_EVENT_TRAIL=os.path.join(workdir, f"trail{gid}.jsonl"),
+    )
+    env.update(env_extra)
+    log = open(
+        os.path.join(workdir, f"g{gid}.log"), "ab", buffering=0
+    )
+    return subprocess.Popen(
+        argv or [sys.executable, _EXAMPLE],
+        env=env,
+        stdout=log,
+        stderr=subprocess.STDOUT,
+        cwd=REPO,
+    )
+
+
+def _read_log(workdir: str, gid: int) -> str:
+    try:
+        with open(os.path.join(workdir, f"g{gid}.log"), "rb") as f:
+            return f.read().decode(errors="replace")
+    except OSError:
+        return ""
+
+
+def _worker_env(scn: Scenario, gid: int, respawn: bool = False
+                ) -> Dict[str, str]:
+    env = dict(scn.common_env)
+    schedule = scn.survivor_schedule if gid == 0 else scn.victim_schedule
+    env.update(scn.survivor_env if gid == 0 else scn.victim_env)
+    if schedule is not None:
+        env["TORCHFT_FAULT_SCHEDULE"] = json.dumps(schedule)
+    if respawn:
+        # injections fire in the FIRST incarnation only: occurrence
+        # counters are per-process, so a respawned victim would re-arm
+        # the same nth coordinates and die at the same point forever.
+        # Plane-selection env (TORCHFT_DP_CMA etc.) stays.
+        env.pop("TORCHFT_FAULT_SCHEDULE", None)
+        for k in [k for k in env if k.startswith("TORCHFT_FI_")]:
+            env.pop(k)
+    return env
+
+
+def run_scenario(scn: Scenario, workdir: str, steps: int = 16,
+                 timeout_s: float = 600.0,
+                 extra_env: Optional[Dict[str, str]] = None,
+                 worker_argv: Optional[List[str]] = None) -> Result:
+    """One 2-group run under the scenario's schedule; victim = group 1.
+
+    ``extra_env``/``worker_argv`` are the sanitize hooks: the ASan env
+    (TORCHFT_NATIVE_LIB + LD_PRELOAD) must reach ONLY the workers — the
+    runner process itself is uninstrumented, and dlopen'ing the ASan
+    core without its preloaded runtime aborts — and the workers must be
+    the jax-free ``_san_worker`` (ASan's ``__cxa_throw`` interceptor is
+    incompatible with jaxlib's jit tracing)."""
+    from torchft_tpu.coordination import LighthouseServer
+
+    os.makedirs(workdir, exist_ok=True)
+    evidence_dir = os.path.join(workdir, "evidence")
+    os.makedirs(evidence_dir, exist_ok=True)
+    # deterministic toy corpus (no numpy needed: repeatable byte pattern)
+    with open(os.path.join(workdir, "corpus.bin"), "wb") as f:
+        f.write(bytes(range(256)) * 24)
+
+    def worker_env(gid: int, respawn: bool = False) -> Dict[str, str]:
+        env = dict(extra_env or {})
+        env.update(_worker_env(scn, gid, respawn=respawn))
+        return env
+
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+    addr = lighthouse.address().split("//", 1)[-1]
+    procs = {
+        0: _spawn(0, addr, workdir, steps, worker_env(0), worker_argv),
+        1: _spawn(1, addr, workdir, steps, worker_env(1), worker_argv),
+    }
+    respawns = 0
+    consumed_kill_pids: set = set()  # evidence already honored by a respawn
+    deadline = time.monotonic() + timeout_s
+    try:
+        while True:
+            # classify finished workers BEFORE the all-dead break: a
+            # victim whose scheduled kill lands in the same 0.5s poll
+            # window the survivor exits in must still be respawned
+            for gid, p in list(procs.items()):
+                if p.poll() is None or p.returncode == 0:
+                    continue
+                text = _read_log(workdir, gid)
+                kills = [
+                    r for r in read_evidence(evidence_dir)
+                    if r.get("action") == "kill"
+                    and r.get("pid") == p.pid
+                    and p.pid not in consumed_kill_pids
+                ]
+                if kills and respawns < 4:
+                    # a scheduled death (kill evidence written by THIS
+                    # pid): the respawn IS the scenario. The respawned
+                    # worker runs a scrubbed env — see _worker_env — so it
+                    # rejoins, heals, and finishes.
+                    consumed_kill_pids.add(p.pid)
+                    respawns += 1
+                    procs[gid] = _spawn(
+                        gid, addr, workdir, steps,
+                        worker_env(gid, respawn=True), worker_argv,
+                    )
+                elif _env_signature(text) \
+                        or p.returncode in CORRUPTION_SIGNAL_RCS:
+                    return Result(
+                        scn.name, "environmental",
+                        f"g{gid} rc={p.returncode} "
+                        f"sig={_env_signature(text)!r} (documented "
+                        "pre-existing corruption, no injection evidence)",
+                        fired=len(read_evidence(evidence_dir)),
+                        respawns=respawns,
+                    )
+                else:
+                    return Result(
+                        scn.name, "failed",
+                        f"g{gid} rc={p.returncode} not explained by "
+                        f"new injection evidence; log tail: "
+                        f"{text[-1500:]}",
+                        fired=len(read_evidence(evidence_dir)),
+                        respawns=respawns,
+                    )
+            if all(p.poll() is not None for p in procs.values()):
+                break  # every worker exited 0 (nonzero handled above)
+            if time.monotonic() > deadline:
+                return Result(
+                    scn.name, "failed",
+                    f"timeout after {timeout_s}s "
+                    f"(alive: {sorted(g for g, p in procs.items() if p.poll() is None)}, "
+                    f"done: { {g: p.returncode for g, p in procs.items() if p.poll() is not None} })",
+                    respawns=respawns,
+                )
+            time.sleep(0.5)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        lighthouse.shutdown()
+
+    fired = read_evidence(evidence_dir)
+    sums = []
+    for gid in (0, 1):
+        text = _read_log(workdir, gid)
+        m = re.findall(r"param_checksum=(-?[\d.]+|nan|inf)", text)
+        if not m:
+            return Result(
+                scn.name, "failed",
+                f"g{gid} exited 0 but printed no param_checksum; "
+                f"log tail: {text[-800:]}",
+                fired=len(fired), respawns=respawns,
+            )
+        sums.append(m[-1])
+
+    # THE invariant: finite and bit-identical across groups — a torn or
+    # killed transfer never leaked into a committed average
+    if any(s in ("nan", "inf") for s in sums):
+        return Result(
+            scn.name, "failed",
+            f"non-finite committed checksums {sums} — corrupt averages "
+            "committed (the divergence mode)",
+            fired=len(fired), respawns=respawns, checksums=sums,
+        )
+    if sums[0] != sums[1]:
+        return Result(
+            scn.name, "failed",
+            f"checksum divergence across groups: {sums}",
+            fired=len(fired), respawns=respawns, checksums=sums,
+        )
+    if (scn.victim_schedule or scn.survivor_schedule or scn.victim_env) \
+            and not fired:
+        return Result(
+            scn.name, "failed",
+            "scenario completed but NO injection fired (schedule "
+            "coordinates never hit — tighten nth/site)",
+            respawns=respawns, checksums=sums,
+        )
+    if scn.expect_victim_death and respawns == 0:
+        return Result(
+            scn.name, "failed",
+            "expected an injected victim death + respawn; none happened",
+            fired=len(fired), checksums=sums,
+        )
+    return Result(
+        scn.name, "passed", f"checksums {sums[0]} == {sums[1]}",
+        fired=len(fired), respawns=respawns, checksums=sums,
+    )
+
+
+# ---------------------------------------------------------------------------
+# sanitizer mode
+# ---------------------------------------------------------------------------
+
+
+def _libasan_path() -> str:
+    cxx = os.environ.get("CXX", "g++")
+    out = subprocess.run(
+        [cxx, "-print-file-name=libasan.so"],
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+    if not out or out == "libasan.so":
+        raise RuntimeError("libasan.so not found (is gcc installed?)")
+    return out
+
+
+def build_sanitized() -> str:
+    """``make -C native asan``; returns the sanitized .so path."""
+    subprocess.run(
+        ["make", "-C", os.path.join(REPO, "native"), "asan"], check=True
+    )
+    lib = os.path.join(REPO, "torchft_tpu", "_native", "libtftcore_asan.so")
+    assert os.path.exists(lib), lib
+    return lib
+
+
+def sanitize_env(outdir: str) -> Dict[str, str]:
+    lib = build_sanitized()
+    return {
+        "TORCHFT_NATIVE_LIB": lib,
+        "LD_PRELOAD": _libasan_path(),
+        # leaks are expected from the interpreter itself; we hunt
+        # corruption (use-after-free, overflow), not leaks
+        "ASAN_OPTIONS": (
+            "detect_leaks=0:abort_on_error=1:handle_abort=1:"
+            f"log_path={os.path.join(outdir, 'asan')}"
+        ),
+    }
+
+
+def scan_asan_reports(outdir: str) -> List[str]:
+    hits = []
+    for path in sorted(glob.glob(os.path.join(outdir, "asan.*"))):
+        try:
+            with open(path, errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        if "ERROR: AddressSanitizer" in text or "runtime error:" in text:
+            hits.append(path)
+    return hits
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="faultinject-runner", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--scenario", action="append", default=None,
+                    help="run only these scenarios (repeatable)")
+    ap.add_argument("--quick", action="store_true",
+                    help="short matrix: the quick-subset scenarios, "
+                    "fewer steps")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="rebuild the native plane under ASan and fail "
+                    "on any sanitizer report")
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-scenario wall-clock cap (seconds)")
+    ap.add_argument("--outdir", default=None,
+                    help="working dir (default: a fresh temp dir)")
+    ap.add_argument("--list", action="store_true", help="list scenarios")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for s in SCENARIOS:
+            print(f"{s.name:28s} {'[quick] ' if s.quick else '':8s}"
+                  f"{s.description}")
+        return 0
+
+    outdir = args.outdir or tempfile.mkdtemp(prefix="tft_faultmatrix_")
+    os.makedirs(outdir, exist_ok=True)
+    steps = args.steps or (10 if (args.quick or args.sanitize) else 16)
+
+    selected = SCENARIOS
+    if args.scenario:
+        by_name = {s.name: s for s in SCENARIOS}
+        unknown = [n for n in args.scenario if n not in by_name]
+        if unknown:
+            ap.error(f"unknown scenario(s) {unknown}; see --list")
+        selected = [by_name[n] for n in args.scenario]
+    elif args.quick or args.sanitize:
+        selected = [s for s in SCENARIOS if s.quick]
+
+    extra_env: Optional[Dict[str, str]] = None
+    worker_argv: Optional[List[str]] = None
+    if args.sanitize:
+        # worker-only env: the runner process must NOT load the ASan core
+        # (its in-process lighthouse dlopen would abort without the
+        # preloaded runtime), and the workers must be jax-free (ASan's
+        # __cxa_throw interceptor CHECK-fails in jaxlib's jit tracing) —
+        # the numpy worker drives the identical native-plane/RPC/heal
+        # path, which is where every corruption suspect lives
+        extra_env = sanitize_env(outdir)
+        worker_argv = [
+            sys.executable, "-m", "torchft_tpu.faultinject._san_worker"
+        ]
+        print(f"sanitizer armed: {extra_env['TORCHFT_NATIVE_LIB']} "
+              "(jax-free numpy worker)")
+
+    results: List[Result] = []
+    for scn in selected:
+        wd = os.path.join(outdir, scn.name)
+        shutil.rmtree(wd, ignore_errors=True)
+        print(f"--- {scn.name}: {scn.description}")
+        t0 = time.monotonic()
+        res = run_scenario(scn, wd, steps=steps, timeout_s=args.timeout,
+                           extra_env=extra_env, worker_argv=worker_argv)
+        res_s = time.monotonic() - t0
+        print(
+            f"    {res.status.upper()} in {res_s:.1f}s "
+            f"(fired={res.fired} respawns={res.respawns}) {res.detail}"
+        )
+        results.append(res)
+
+    report = {
+        "steps": steps,
+        "sanitize": bool(args.sanitize),
+        "results": [r.__dict__ for r in results],
+    }
+    failed = [r for r in results if r.status == "failed"]
+    if args.sanitize:
+        hits = scan_asan_reports(outdir)
+        report["asan_reports"] = hits
+        if hits:
+            print(f"ASAN REPORTS ({len(hits)}):")
+            for h in hits:
+                print(f"  {h}")
+                with open(h, errors="replace") as f:
+                    head = f.read(2000)
+                print("    " + "\n    ".join(head.splitlines()[:25]))
+            failed.append(Result("sanitizer", "failed",
+                                 f"{len(hits)} ASan report(s)"))
+        else:
+            print("sanitizer: no reports")
+    with open(os.path.join(outdir, "faultmatrix.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"report: {os.path.join(outdir, 'faultmatrix.json')}")
+
+    env_skips = [r for r in results if r.status == "environmental"]
+    if env_skips:
+        print(f"environmental (documented corruption, recorded): "
+              f"{[r.scenario for r in env_skips]}")
+    if failed:
+        print(f"FAILED: {[r.scenario for r in failed]}")
+        return 1
+    print("fault matrix clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

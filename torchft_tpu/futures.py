@@ -191,6 +191,12 @@ _TIMEOUT_MANAGER = _TimeoutManager()
 def future_timeout(fut: Future[T], timeout: timedelta) -> Future[T]:
     """Return a future that mirrors ``fut`` but fails with TimeoutError if it
     is not complete within ``timeout`` (torchft/futures.py:123-135)."""
+    from torchft_tpu.faultinject.core import fault_point
+
+    # deadline-machinery injection site: `error` (exc=TimeoutError)
+    # simulates an expired deadline without waiting it out; `delay` stalls
+    # the registering thread like a slow op-issue path would
+    fault_point("future.deadline", ms_budget=timeout.total_seconds() * 1000)
     out: Future[T] = Future()
 
     def copy(f: Future[T]) -> None:

@@ -39,6 +39,7 @@ from torchft_tpu.checkpointing.http_transport import HTTPTransport
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.collectives import Collectives, ReduceOp
 from torchft_tpu.coordination import ManagerClient, ManagerServer
+from torchft_tpu.faultinject.core import fault_point
 from torchft_tpu.futures import Future, future_timeout, run_in_executor
 from torchft_tpu.profiling import StepTimer
 from torchft_tpu.store import StoreClient
@@ -658,37 +659,63 @@ class Manager:
                     f"healing: fetching checkpoint metadata from "
                     f"{quorum.recover_src_manager_address} at step {quorum.max_step}"
                 )
-                primary_client = ManagerClient(
-                    quorum.recover_src_manager_address,
-                    connect_timeout=self._connect_timeout,
-                )
-                try:
-                    checkpoint_metadata = primary_client._checkpoint_metadata(
-                        self._rank, timeout=self._timeout
-                    )
-                finally:
-                    primary_client.close()
+                # protocol invariant, NOT a retryable transfer failure —
+                # keep it outside the retry handler below so a lighthouse
+                # that heals us without naming a source crashes loudly
+                # instead of looping on a doomed heal forever
                 assert (
                     quorum.recover_src_rank is not None
                 ), "must have a recover rank when healing"
-
-                # the user state dict is only applied from the main thread;
-                # stage it here
-                with telemetry.TRACER.span(
-                    "heal_recv",
-                    trace_id=self._trace_id(),
-                    src=quorum.recover_src_manager_address,
-                    step=quorum.max_step,
-                ):
-                    self._pending_state_dict = cast(
-                        Dict[str, object],
-                        self._checkpoint_transport.recv_checkpoint(
-                            src_rank=quorum.recover_src_rank,
-                            metadata=checkpoint_metadata,
-                            step=quorum.max_step,
-                            timeout=self._timeout,
-                        ),
+                try:
+                    primary_client = ManagerClient(
+                        quorum.recover_src_manager_address,
+                        connect_timeout=self._connect_timeout,
                     )
+                    try:
+                        checkpoint_metadata = primary_client._checkpoint_metadata(
+                            self._rank, timeout=self._timeout
+                        )
+                    finally:
+                        primary_client.close()
+
+                    # the user state dict is only applied from the main
+                    # thread; stage it here
+                    with telemetry.TRACER.span(
+                        "heal_recv",
+                        trace_id=self._trace_id(),
+                        src=quorum.recover_src_manager_address,
+                        step=quorum.max_step,
+                    ):
+                        self._pending_state_dict = cast(
+                            Dict[str, object],
+                            self._checkpoint_transport.recv_checkpoint(
+                                src_rank=quorum.recover_src_rank,
+                                metadata=checkpoint_metadata,
+                                step=quorum.max_step,
+                                timeout=self._timeout,
+                            ),
+                        )
+                except Exception as e:  # noqa: BLE001 — heal must be retryable
+                    # A torn/failed checkpoint transfer (the serving peer
+                    # died mid-stream — fault-injection scenario
+                    # ckpt_serve_death, previously a trainer-killing
+                    # struct.error through wait_quorum) must not take this
+                    # worker down: the quorum/plane are fine, only the
+                    # state fetch failed. Stay un-healed, latch the error
+                    # so the step aborts at the commit barrier, and let
+                    # the next start_quorum re-request the heal (we are
+                    # still behind max_step, so the lighthouse re-selects
+                    # us for recovery).
+                    self._healing = False
+                    self._pending_state_dict = None
+                    self._logger.exception(
+                        f"heal transfer failed; retrying next quorum: {e}"
+                    )
+                    telemetry.emit(
+                        "heal_failed", step=quorum.max_step, error=str(e)
+                    )
+                    self.report_error(e)
+                    return
                 self.load_state_dict(
                     cast(Dict[str, int], self._pending_state_dict["torchft"])
                 )
@@ -1091,6 +1118,10 @@ class Manager:
         step by the time a pipelined vote resolves)."""
         import time as _time
 
+        # injection window the ROADMAP flake lives in: workers observed
+        # dying silently right AFTER the commit barrier's drain — a kill
+        # scheduled here reproduces that timing on demand
+        fault_point("commit.vote", match="prepare", step=self._step)
         t0 = _time.perf_counter()
         for work in self._pending_work:
             if self._errored is not None:

@@ -262,6 +262,12 @@ class CollectivesProxy(Collectives):
                 fut.set_exception(payload)
 
     def _submit(self, name: str, *args, **kwargs) -> Work:
+        from torchft_tpu.faultinject.core import fault_point
+
+        # parent-side site; the child backend's own hooks fire too (it
+        # inherits TORCHFT_FAULT_SCHEDULE through the spawn env), so a
+        # schedule can target either side of the isolation boundary
+        fault_point("collective.issue", match=f"proxy.{name}")
         proc = self._proc
         if proc is None or not proc.is_alive():
             return Work(

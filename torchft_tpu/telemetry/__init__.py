@@ -224,6 +224,15 @@ FLIGHT_DUMPS = REGISTRY.counter(
     labelnames=("reason",),
 )
 
+# fault-injection plane (torchft_tpu.faultinject): every fired scheduled
+# injection is counted here AND emitted as a fault_injected trail event,
+# so a chaos run's evidence is collected without extra wiring
+FAULTS_INJECTED = REGISTRY.counter(
+    "tft_faults_injected_total",
+    "Scheduled fault injections fired, by site and action",
+    labelnames=("site", "action"),
+)
+
 # Pre-create the CLOSED label sets so their series exist (zero-valued)
 # from process start: dashboards and absent-series alerts can then tell
 # "healthy, zero heals" from "trainer not scraped". Open-ended label sets

@@ -50,6 +50,7 @@ CANONICAL_EVENTS = (
     "quorum_ready",
     "heal_begin",
     "heal_end",
+    "heal_failed",
     "peer_death",
     "eviction",
     "commit",
@@ -60,6 +61,7 @@ CANONICAL_EVENTS = (
     "step_outlier",
     "watchdog_stall",
     "flight_dump",
+    "fault_injected",
 )
 
 
