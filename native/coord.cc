@@ -348,8 +348,8 @@ std::string Lighthouse::address() const {
 void Lighthouse::tick_loop() {
   std::unique_lock<std::mutex> lk(mu_);
   while (running_.load()) {
-    cv_.wait_for(lk, std::chrono::milliseconds(opt_.quorum_tick_ms),
-                 [this] { return !running_.load(); });
+    cv_wait_deadline(cv_, lk, now_ms() + opt_.quorum_tick_ms,
+                     [this] { return !running_.load(); });
     if (!running_.load()) break;
     quorum_tick();
   }
@@ -536,9 +536,8 @@ Value Lighthouse::handle_quorum(const Value& req, int64_t deadline) {
   quorum_tick();
 
   while (true) {
-    bool ok = cv_.wait_until(
-        lk, std::chrono::steady_clock::time_point(
-                std::chrono::milliseconds(deadline)),
+    bool ok = cv_wait_deadline(
+        cv_, lk, deadline,
         [&] { return quorum_seq_ > seen || !running_.load(); });
     if (!running_.load()) throw RpcError(CANCELLED, "lighthouse shutting down");
     if (!ok) throw RpcError(DEADLINE_EXCEEDED, "quorum wait timed out");
@@ -1061,20 +1060,24 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
       quorums_[++quorum_seq_] = q;
       quorum_error_.reset();
       while (quorums_.size() > 16) quorums_.erase(quorums_.begin());
-    } catch (const RpcError& e) {
+    } catch (const std::exception& e) {
       // Fan the failure out to all waiting local ranks (the reference only
       // surfaces it on the triggering rank and lets peers hit their own
-      // deadline; propagating is strictly more informative).
+      // deadline; propagating is strictly more informative). std::exception,
+      // not just RpcError: a malformed lighthouse reply makes from_value/
+      // at() throw WireError, and an escaping exception here skips BOTH the
+      // seq bump and notify_all — every peer handler parked in the cv wait
+      // below would stall until its own deadline [bugprone-exception-escape
+      // class; flagged while wiring the clang-tidy gate].
       quorum_error_ = std::string(e.what());
       quorum_seq_++;
     }
     cv_.notify_all();
   }
 
-  bool ok = cv_.wait_until(lk,
-                           std::chrono::steady_clock::time_point(
-                               std::chrono::milliseconds(deadline)),
-                           [&] { return quorum_seq_ > seen || !running_.load(); });
+  bool ok = cv_wait_deadline(
+      cv_, lk, deadline,
+      [&] { return quorum_seq_ > seen || !running_.load(); });
   if (!running_.load()) throw RpcError(CANCELLED, "manager shutting down");
   if (!ok) throw RpcError(DEADLINE_EXCEEDED, "quorum wait timed out");
 
@@ -1128,10 +1131,9 @@ Value ManagerSrv::handle_should_commit(const Value& req, int64_t deadline) {
     cv_.notify_all();
   }
 
-  bool ok = cv_.wait_until(lk,
-                           std::chrono::steady_clock::time_point(
-                               std::chrono::milliseconds(deadline)),
-                           [&] { return commit_seq_ > seen || !running_.load(); });
+  bool ok = cv_wait_deadline(
+      cv_, lk, deadline,
+      [&] { return commit_seq_ > seen || !running_.load(); });
   if (!running_.load()) throw RpcError(CANCELLED, "manager shutting down");
   if (!ok) throw RpcError(DEADLINE_EXCEEDED, "should_commit wait timed out");
 
@@ -1207,10 +1209,9 @@ Value KvStore::handle_rpc(const std::string& method, const Value& req,
     const std::string k = req.gets("k");
     bool wait = req.getb("wait", true);
     if (wait) {
-      bool ok = cv_.wait_until(lk,
-                               std::chrono::steady_clock::time_point(
-                                   std::chrono::milliseconds(deadline)),
-                               [&] { return data_.count(k) > 0 || !running_.load(); });
+      bool ok = cv_wait_deadline(
+          cv_, lk, deadline,
+          [&] { return data_.count(k) > 0 || !running_.load(); });
       if (!ok || !data_.count(k))
         throw RpcError(DEADLINE_EXCEEDED, "store.get timed out waiting for " + k);
     } else if (!data_.count(k)) {

@@ -119,6 +119,16 @@ void reduce_from_bf16(float* acc, const uint16_t* in, size_t n, DpOp op) {
   }
 }
 
+// EAGAIN/EWOULDBLOCK may be the same value (they are on Linux) — the
+// guard keeps the portable double-check without tripping -Wlogical-op
+// in every nonblocking pump
+inline bool err_wouldblock(int e) {
+#if defined(EWOULDBLOCK) && EWOULDBLOCK != EAGAIN
+  if (e == EWOULDBLOCK) return true;
+#endif
+  return e == EAGAIN;
+}
+
 // poll-bounded helpers for the tiny CMA control messages (they always fit
 // the socket buffer, so these loops complete in one or two iterations)
 bool send_small(int fd, const void* buf, size_t n, int64_t deadline_ms,
@@ -130,7 +140,7 @@ bool send_small(int fd, const void* buf, size_t n, int64_t deadline_ms,
       off += (size_t)k;
       continue;
     }
-    if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+    if (k < 0 && err_wouldblock(errno)) {
       int64_t left = deadline_ms - now_ms();
       if (left <= 0) {
         *timed_out = true;
@@ -141,7 +151,7 @@ bool send_small(int fd, const void* buf, size_t n, int64_t deadline_ms,
       ::poll(&pfd, 1, (int)(left > 200 ? 200 : left));
       continue;
     }
-    *err = std::string("send: ") + (k == 0 ? "closed" : strerror(errno));
+    *err = std::string("send: ") + (k == 0 ? "closed" : errno_str(errno));
     return false;
   }
   return true;
@@ -156,7 +166,7 @@ bool recv_small(int fd, void* buf, size_t n, int64_t deadline_ms,
       off += (size_t)k;
       continue;
     }
-    if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+    if (k < 0 && err_wouldblock(errno)) {
       int64_t left = deadline_ms - now_ms();
       if (left <= 0) {
         *timed_out = true;
@@ -167,7 +177,7 @@ bool recv_small(int fd, void* buf, size_t n, int64_t deadline_ms,
       ::poll(&pfd, 1, (int)(left > 200 ? 200 : left));
       continue;
     }
-    *err = std::string("recv: ") + (k == 0 ? "closed" : strerror(errno));
+    *err = std::string("recv: ") + (k == 0 ? "closed" : errno_str(errno));
     return false;
   }
   return true;
@@ -383,7 +393,7 @@ bool DataPlane::wait_ready(int64_t timeout_ms, std::string* err) {
       *err = "timeout waiting for stripe peers";
       return false;
     }
-    socks_cv_.wait_for(g, std::chrono::milliseconds(left > 100 ? 100 : left));
+    cv_wait_deadline(socks_cv_, g, now_ms() + (left > 100 ? 100 : left));
   }
 }
 
@@ -470,7 +480,7 @@ bool DataPlane::hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
     }
     if (pr < 0) {
       if (errno == EINTR) continue;
-      *err = std::string("poll: ") + strerror(errno);
+      *err = std::string("poll: ") + errno_str(errno);
       return false;
     }
     if (send_i >= 0 && (pfd[send_i].revents & (POLLOUT | POLLERR | POLLHUP))) {
@@ -480,11 +490,11 @@ bool DataPlane::hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
                            sizeof(shdr) - sh_off, MSG_NOSIGNAL);
         if (k > 0) {
           sh_off += (size_t)k;
-        } else if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        } else if (k < 0 && err_wouldblock(errno)) {
           break;
         } else {
           *send_failed = true;
-          *err = std::string("send: ") + (k == 0 ? "closed" : strerror(errno));
+          *err = std::string("send: ") + (k == 0 ? "closed" : errno_str(errno));
           return false;
         }
       }
@@ -492,11 +502,11 @@ bool DataPlane::hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
         ssize_t k = ::send(send_fd, sbuf + s_off, sn - s_off, MSG_NOSIGNAL);
         if (k > 0) {
           s_off += (size_t)k;
-        } else if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        } else if (k < 0 && err_wouldblock(errno)) {
           break;
         } else {
           *send_failed = true;
-          *err = std::string("send: ") + (k == 0 ? "closed" : strerror(errno));
+          *err = std::string("send: ") + (k == 0 ? "closed" : errno_str(errno));
           return false;
         }
       }
@@ -515,10 +525,10 @@ bool DataPlane::hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
               return false;
             }
           }
-        } else if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        } else if (k < 0 && err_wouldblock(errno)) {
           break;
         } else {
-          *err = std::string("recv: ") + (k == 0 ? "closed" : strerror(errno));
+          *err = std::string("recv: ") + (k == 0 ? "closed" : errno_str(errno));
           return false;
         }
       }
@@ -526,10 +536,10 @@ bool DataPlane::hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
         ssize_t k = ::recv(recv_fd, rbuf + r_off, rn - r_off, 0);
         if (k > 0) {
           r_off += (size_t)k;
-        } else if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        } else if (k < 0 && err_wouldblock(errno)) {
           break;
         } else {
-          *err = std::string("recv: ") + (k == 0 ? "closed" : strerror(errno));
+          *err = std::string("recv: ") + (k == 0 ? "closed" : errno_str(errno));
           return false;
         }
       }
@@ -540,7 +550,9 @@ bool DataPlane::hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
 
 void DataPlane::enable_cma(const std::vector<int64_t>& pids) {
   peer_pids_ = pids;
-  cma_ = true;
+  // release-store publishes peer_pids_ to the already-running stripe
+  // workers (acquire-load in run_stripe); see the member comment
+  cma_.store(true, std::memory_order_release);
 }
 
 // CMA hop: descriptors and acks ride the stripe socket; the payload is
@@ -598,7 +610,7 @@ bool DataPlane::cma_hop(int send_fd, int recv_fd, const uint8_t* sbuf,
     ssize_t k = ::process_vm_readv((pid_t)peer_pids_[left], &lv, 1, &rv, 1, 0);
     if (k <= 0) {
       *err = std::string("process_vm_readv: ") +
-             (k == 0 ? "zero read" : strerror(errno));
+             (k == 0 ? "zero read" : errno_str(errno));
       return false;
     }
     off += (size_t)k;
@@ -640,7 +652,10 @@ int DataPlane::run_stripe(int stripe_idx, Job& job, int* bad_peer,
   // CMA pulls exact f32 out of the peer's memory — the wire codec is
   // moot (and the exactness is deterministic: the owner's bytes are
   // distributed verbatim in the allgather phase)
-  if (cma_) job.wire_bf16 = false;
+  // one acquire-load per job: pairs with enable_cma's release-store so
+  // peer_pids_ is fully visible before the first CMA hop of this job
+  const bool use_cma = cma_.load(std::memory_order_acquire);
+  if (use_cma) job.wire_bf16 = false;
 
   float* flat = (float*)job.base;
   int64_t n = job.nelems;
@@ -670,10 +685,10 @@ int DataPlane::run_stripe(int stripe_idx, Job& job, int* bad_peer,
   bool send_failed = false;
   bool timed_out = false;
   auto do_hop = [&](const uint8_t* sb, size_t sn, uint8_t* rb, size_t rn) {
-    return cma_ ? cma_hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
-                          job.deadline_ms, &send_failed, &timed_out, err)
-                : hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
-                      job.deadline_ms, &send_failed, &timed_out, err);
+    return use_cma ? cma_hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
+                             job.deadline_ms, &send_failed, &timed_out, err)
+                   : hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
+                         job.deadline_ms, &send_failed, &timed_out, err);
   };
   // a deadline or LOCAL shutdown names NO peer: slow-but-alive (or our
   // own teardown) must surface as retryable, not as an eviction-worthy

@@ -123,8 +123,14 @@ class DataPlane {
 
   std::vector<std::unique_ptr<Stripe>> stripes_;
 
-  bool cma_ = false;
-  std::vector<int64_t> peer_pids_;  // indexed by ring rank
+  // atomic publication flag: enable_cma() runs on the Python control
+  // thread AFTER the stripe workers (started in the constructor) are
+  // already live — peer_pids_ is written first, then cma_ is
+  // store(release)d, and the workers' load(acquire) in run_stripe/
+  // cma_hop makes the pids visible. A plain bool here is a data race
+  // (the publication relied on the job-queue mutex by accident).
+  std::atomic<bool> cma_{false};
+  std::vector<int64_t> peer_pids_;  // published by cma_ release-store
 
   // hello handshakes run off the accept thread so one stalled dial can't
   // starve every other peer's stripe connections during rendezvous;

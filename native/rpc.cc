@@ -24,6 +24,13 @@ int64_t now_ms() {
       .count();
 }
 
+std::string errno_str(int e) {
+  char buf[128];
+  // GNU strerror_r: fills buf OR returns a pointer to an immutable
+  // static string — either way no shared mutable state (see rpc.h)
+  return std::string(strerror_r(e, buf, sizeof(buf)));
+}
+
 static void set_keepalive(int fd) {
   int on = 1;
   setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &on, sizeof(on));
@@ -75,7 +82,7 @@ int tcp_listen(const std::string& bind_addr, std::string* err) {
   bool v6 = host.empty() || host == "::" || host.find(':') != std::string::npos;
   int fd = socket(v6 ? AF_INET6 : AF_INET, SOCK_STREAM, 0);
   if (fd < 0) {
-    if (err) *err = std::string("socket: ") + strerror(errno);
+    if (err) *err = std::string("socket: ") + errno_str(errno);
     return -1;
   }
   int on = 1;
@@ -117,7 +124,7 @@ int tcp_listen(const std::string& bind_addr, std::string* err) {
     rc = bind(fd, (sockaddr*)&sa, sizeof(sa));
   }
   if (rc != 0 || listen(fd, 1024) != 0) {
-    if (err) *err = std::string("bind/listen: ") + strerror(errno);
+    if (err) *err = std::string("bind/listen: ") + errno_str(errno);
     close(fd);
     return -1;
   }
@@ -161,13 +168,13 @@ int tcp_connect(const std::string& host, int port, int64_t timeout_ms,
         socklen_t slen = sizeof(soerr);
         getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
         rc = soerr == 0 ? 0 : -1;
-        if (soerr != 0 && err) *err = strerror(soerr);
+        if (soerr != 0 && err) *err = errno_str(soerr);
       } else {
         rc = -1;
         if (err) *err = "connect timeout";
       }
     } else if (rc != 0 && err) {
-      *err = strerror(errno);
+      *err = errno_str(errno);
     }
     if (rc == 0) {
       fcntl(fd, F_SETFL, flags);  // back to blocking
@@ -396,17 +403,23 @@ RpcClient::RpcClient(const std::string& addr, int64_t connect_timeout_ms)
 RpcClient::~RpcClient() { disconnect(); }
 
 void RpcClient::disconnect() {
-  if (fd_ >= 0) {
-    close(fd_);
-    fd_ = -1;
-  }
+  // close only ever happens under fd_mu_ — see abort()
+  std::lock_guard<std::mutex> g(fd_mu_);
+  int fd = fd_.exchange(-1);
+  if (fd >= 0) close(fd);
 }
 
 void RpcClient::abort() {
-  // Intentionally does not take mu_ (a blocked call() holds it). shutdown()
-  // on the fd is safe cross-thread and makes the blocked recv/send fail;
-  // the call() path then disconnects and reconnects on next use.
-  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+  // Intentionally does not take mu_ (a blocked call() holds it — making
+  // that call fail fast is the whole point). shutdown() on the fd makes
+  // the blocked recv/send fail; the call() path then disconnects and
+  // reconnects on next use. fd_mu_ serializes us against disconnect()'s
+  // close: without it, the fd NUMBER could be closed and recycled by an
+  // unrelated subsystem (stripe socket, checkpoint HTTP) between our
+  // load and the shutdown, tearing down someone else's live connection.
+  std::lock_guard<std::mutex> g(fd_mu_);
+  int fd = fd_.load();
+  if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
 }
 
 void RpcClient::ensure_connected(int64_t timeout_ms) {

@@ -12,6 +12,7 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <functional>
 #include <map>
@@ -26,6 +27,57 @@
 namespace tft {
 
 int64_t now_ms();  // monotonic clock, milliseconds
+
+// Thread-safe strerror. glibc < 2.32 keeps strerror()'s result in one
+// shared static buffer (clang-tidy: concurrency-mt-unsafe), and the
+// stripe workers hit error paths concurrently — two simultaneous hop
+// failures could interleave each other's message text.
+std::string errno_str(int e);
+
+// Condition-variable wait against an absolute now_ms() deadline.
+//
+// Production builds wait on the steady clock directly. Under
+// -fsanitize=thread the SAME deadline is converted to a system_clock
+// wait: libstdc++ implements steady-clock cv waits via
+// pthread_cond_clockwait when glibc provides it (>= 2.30), and gcc 10's
+// libtsan has NO interceptor for clockwait — the wait's internal
+// unlock/relock becomes invisible, TSan believes the mutex is still
+// held, and every later interaction with it reports phantom
+// double-locks and races where both sides "hold" the lock (observed as
+// ~18 reports/worker across the whole fault matrix before this shim).
+// system_clock waits go through pthread_cond_timedwait, which IS
+// intercepted. The only semantic difference — sensitivity to wall-clock
+// jumps — is confined to sanitizer runs.
+template <typename Pred>
+inline bool cv_wait_deadline(std::condition_variable& cv,
+                             std::unique_lock<std::mutex>& lk,
+                             int64_t deadline_ms, Pred pred) {
+#if defined(__SANITIZE_THREAD__)
+  auto sys = std::chrono::system_clock::now() +
+             std::chrono::milliseconds(deadline_ms - now_ms());
+  return cv.wait_until(lk, sys, pred);
+#else
+  return cv.wait_until(
+      lk,
+      std::chrono::steady_clock::time_point(
+          std::chrono::milliseconds(deadline_ms)),
+      pred);
+#endif
+}
+
+// no-predicate form: returns on notify OR deadline (caller re-checks
+// its own condition, e.g. the wait_ready poll loop)
+inline void cv_wait_deadline(std::condition_variable& cv,
+                             std::unique_lock<std::mutex>& lk,
+                             int64_t deadline_ms) {
+#if defined(__SANITIZE_THREAD__)
+  cv.wait_until(lk, std::chrono::system_clock::now() +
+                        std::chrono::milliseconds(deadline_ms - now_ms()));
+#else
+  cv.wait_until(lk, std::chrono::steady_clock::time_point(
+                        std::chrono::milliseconds(deadline_ms)));
+#endif
+}
 
 // ---- low-level socket helpers -------------------------------------------
 // fd < 0 on failure. host may be a hostname, IPv4/IPv6 literal, or empty
@@ -120,7 +172,15 @@ class RpcClient {
   int port_ = 0;
   int64_t connect_timeout_ms_;
   std::mutex mu_;
-  int fd_ = -1;
+  // atomic: abort() reads it WITHOUT mu_ (a blocked call() holds the
+  // lock, which is the whole point of abort) while call()'s
+  // disconnect/reconnect writes it under mu_ — a plain int is a data
+  // race. fd_mu_ additionally serializes abort()'s shutdown against
+  // disconnect()'s close so the fd number can't be recycled in between
+  // (never held across blocking IO; strictly after mu_ when both are
+  // taken, so no ordering cycle).
+  std::mutex fd_mu_;
+  std::atomic<int> fd_{-1};
 };
 
 }  // namespace tft

@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""clang-tidy gate for the native plane (driven by `make -C native tidy`).
+
+Runs clang-tidy (config: native/.clang-tidy) over the given sources,
+normalizes each finding to a stable key
+
+    <check-id>:<file>:<function-or-line-bucket>
+
+and diffs the set against ``tidy-baseline.txt``:
+
+  * a finding NOT in the baseline  -> NEW, gate fails (exit 1)
+  * a baseline entry that no longer fires -> STALE, gate fails (exit 1)
+    (same stale-suppression contract as torchft_tpu/analysis)
+  * clang-tidy binary missing      -> exit 3 with instructions
+
+Keys bucket line numbers to the nearest 10 so unrelated edits above a
+baselined finding don't churn the baseline; refresh with --update.
+
+The dev container ships g++ only (no llvm) — exit 3 there is expected
+and documented in docs/static_analysis.md; the locally-runnable subset
+is `make -C native warn` (strict gcc warnings, -Werror).
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import shutil
+import subprocess
+import sys
+
+# clang-tidy diagnostic line: <path>:<line>:<col>: warning: <msg> [<check>]
+_DIAG = re.compile(
+    r"^(?P<path>[^:\s][^:]*):(?P<line>\d+):\d+:\s+"
+    r"(?:warning|error):\s+.*\[(?P<check>[\w.,-]+)\]\s*$"
+)
+
+
+def normalize(path: str, line: int, check: str) -> str:
+    # strip any leading dirs: the gate runs from native/ but clang-tidy
+    # may print absolute paths
+    name = path.rsplit("/", 1)[-1]
+    bucket = (line // 10) * 10
+    return f"{check}:{name}:{bucket}"
+
+
+def parse_findings(output: str) -> "set[str]":
+    found = set()
+    for ln in output.splitlines():
+        m = _DIAG.match(ln.strip())
+        if m:
+            # a single diag can carry a comma-joined check list
+            for check in m.group("check").split(","):
+                found.add(normalize(m.group("path"), int(m.group("line")), check))
+    return found
+
+
+def read_baseline(path: str) -> "set[str]":
+    entries = set()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for ln in f:
+                ln = ln.strip()
+                if ln and not ln.startswith("#"):
+                    entries.add(ln)
+    except FileNotFoundError:
+        pass
+    return entries
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clang-tidy", default="clang-tidy")
+    ap.add_argument("--baseline", default="tidy-baseline.txt")
+    ap.add_argument("--sources", nargs="+", required=True)
+    ap.add_argument(
+        "--update", action="store_true",
+        help="rewrite the baseline from the current findings",
+    )
+    ap.add_argument(
+        "compile_flags", nargs="*",
+        help="flags after `--` are passed to clang-tidy's compiler invocation",
+    )
+    args = ap.parse_args()
+
+    if shutil.which(args.clang_tidy) is None:
+        print(
+            f"tidy_gate: '{args.clang_tidy}' not found. This container has "
+            "no llvm toolchain; run `make -C native warn` for the "
+            "gcc-runnable subset, or run the tidy gate on a machine with "
+            "clang-tidy >= 12 (config: native/.clang-tidy). "
+            "See docs/static_analysis.md.",
+            file=sys.stderr,
+        )
+        return 3
+
+    cmd = [args.clang_tidy, "--quiet", *args.sources, "--", *args.compile_flags]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    findings = parse_findings(proc.stdout + proc.stderr)
+
+    if args.update:
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            f.write(
+                "# clang-tidy baseline — one normalized key per line\n"
+                "# (<check>:<file>:<line-bucket>); regenerate with\n"
+                "#   python3 tidy_gate.py --update ...  (via `make tidy`)\n"
+            )
+            for key in sorted(findings):
+                f.write(key + "\n")
+        print(f"tidy_gate: baseline rewritten with {len(findings)} entries")
+        return 0
+
+    baseline = read_baseline(args.baseline)
+    new = sorted(findings - baseline)
+    stale = sorted(baseline - findings)
+
+    for key in new:
+        print(f"NEW      {key}")
+    for key in stale:
+        print(f"STALE    {key}  (baseline entry no longer fires — remove it)")
+    ok = not new and not stale
+    print(
+        f"tidy_gate: {len(findings)} finding(s), {len(new)} new, "
+        f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""Seeded fixture: blocking call while holding a lock."""
+import threading
+import time
+
+
+class Blocky:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last = 0.0
+
+    def slow(self):
+        with self._lock:
+            time.sleep(0.5)
+            self.last = time.monotonic()

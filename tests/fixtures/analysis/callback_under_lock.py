@@ -1,0 +1,14 @@
+"""Seeded fixture: future resolved while holding a lock."""
+import threading
+
+
+class Resolver:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+
+    def fail_all(self, exc):
+        with self._lock:
+            for fut in self._pending:
+                fut.set_exception(exc)
+            self._pending.clear()

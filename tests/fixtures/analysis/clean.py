@@ -1,0 +1,31 @@
+"""Fixture that every concurrency rule must pass: the disciplined twin
+of the seeded-bug files (single lock order, no blocking under locks,
+annotated cross-thread state, predicate-looped waits, named daemon
+thread)."""
+import threading
+import time
+
+
+class Clean:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._n = 0  # guarded-by: _cond
+        # unguarded-ok: handoff — written only before the worker starts
+        self._cfg = None
+        self.ready = False
+        self._t = threading.Thread(
+            target=self._loop, name="clean_loop", daemon=True
+        )
+
+    def _loop(self):
+        with self._cond:
+            self._n += 1
+            while not self.ready:
+                self._cond.wait()
+
+    def bump(self):
+        with self._cond:
+            self._n += 1
+
+    def idle(self):
+        time.sleep(0.01)

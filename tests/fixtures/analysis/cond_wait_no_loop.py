@@ -1,0 +1,14 @@
+"""Seeded fixture: Condition.wait outside a predicate loop."""
+import threading
+
+
+class NoLoop:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.ready = False
+
+    def wait_once(self):
+        with self._cond:
+            if not self.ready:
+                self._cond.wait()
+            return self.ready

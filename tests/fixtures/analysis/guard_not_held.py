@@ -1,0 +1,18 @@
+"""Seeded fixture: guarded-by declared, one write site not under the lock."""
+import threading
+
+
+class BadGuard:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._n = 0  # guarded-by: _mu
+        self._t = threading.Thread(
+            target=self._loop, name="fixture_loop", daemon=True
+        )
+
+    def _loop(self):
+        with self._mu:
+            self._n += 1
+
+    def bump(self):
+        self._n += 1

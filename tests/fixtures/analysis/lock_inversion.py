@@ -1,0 +1,18 @@
+"""Seeded fixture: lock-order inversion (lock-order-cycle)."""
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                return 2

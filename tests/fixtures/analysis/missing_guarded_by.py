@@ -1,0 +1,16 @@
+"""Seeded fixture: cross-thread write with no guarded-by annotation."""
+import threading
+
+
+class Unguarded:
+    def __init__(self):
+        self._n = 0
+        self._t = threading.Thread(
+            target=self._loop, name="fixture_loop", daemon=True
+        )
+
+    def _loop(self):
+        self._n += 1
+
+    def bump(self):
+        self._n += 1

@@ -1,0 +1,8 @@
+"""Seeded fixture: anonymous thread (thread-unnamed)."""
+import threading
+
+
+def spawn():
+    t = threading.Thread(target=print, daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,14 @@
+// Seeded fixture: C++ side of a wire-constant mismatch. STR exists here
+// but not in wire_mismatch_py.txt; F64's value disagrees.
+#pragma once
+
+namespace fixture {
+
+enum class Type : uint8_t {
+  NIL = 0,
+  I64 = 1,
+  F64 = 2,
+  STR = 3,
+};
+
+}  // namespace fixture

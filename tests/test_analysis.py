@@ -1,0 +1,242 @@
+"""Tests for the static-analysis suite (``python -m torchft_tpu.analysis``).
+
+Two halves:
+
+* **fixture tests** — each seeded-bug file under ``tests/fixtures/analysis``
+  must be caught by exactly the rule it seeds, and the ``clean.py`` twin
+  must pass every rule (the analyzers are themselves code under test);
+* **the repo gate** — the real tree must come out clean (0 active
+  findings, 0 stale suppressions) through the same entry point CI runs.
+  This is the thin tier-1 wrapper the doc-drift checks moved into when
+  they left ``test_tracing.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from torchft_tpu.analysis import Baseline, run_all
+from torchft_tpu.analysis import concurrency, docdrift, wiredrift
+from torchft_tpu.analysis.__main__ import main as analysis_main
+from torchft_tpu.analysis.base import Finding
+
+REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
+
+
+def _fixture_findings(name):
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+        return concurrency.analyze_source(name, f.read())
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# concurrency lint fixtures
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrencyFixtures:
+    def test_lock_inversion_caught(self):
+        finds = _fixture_findings("lock_inversion.py")
+        assert "lock-order-cycle" in _rules(finds)
+        (f,) = [f for f in finds if f.rule == "lock-order-cycle"]
+        assert "self._a" in f.symbol and "self._b" in f.symbol
+
+    def test_blocking_under_lock_caught(self):
+        finds = _fixture_findings("blocking_under_lock.py")
+        hits = [f for f in finds if f.rule == "blocking-under-lock"]
+        assert hits and "sleep" in hits[0].symbol
+
+    def test_callback_under_lock_caught(self):
+        finds = _fixture_findings("callback_under_lock.py")
+        hits = [f for f in finds if f.rule == "callback-under-lock"]
+        assert hits and "set_exception" in hits[0].symbol
+
+    def test_missing_guarded_by_caught(self):
+        finds = _fixture_findings("missing_guarded_by.py")
+        hits = [f for f in finds if f.rule == "unguarded-shared-write"]
+        assert [f.symbol for f in hits] == ["Unguarded._n"]
+
+    def test_guard_not_held_caught(self):
+        finds = _fixture_findings("guard_not_held.py")
+        hits = [f for f in finds if f.rule == "guard-not-held"]
+        assert len(hits) == 1
+        assert hits[0].symbol == "BadGuard._n@bump"
+        # the annotated, locked write is NOT flagged
+        assert not [f for f in finds if f.rule == "unguarded-shared-write"]
+
+    def test_cond_wait_no_loop_caught(self):
+        finds = _fixture_findings("cond_wait_no_loop.py")
+        assert "cond-wait-no-loop" in _rules(finds)
+
+    def test_unnamed_thread_caught(self):
+        finds = _fixture_findings("unnamed_thread.py")
+        assert "thread-unnamed" in _rules(finds)
+
+    def test_clean_fixture_passes_every_rule(self):
+        finds = _fixture_findings("clean.py")
+        assert finds == [], [f.render() for f in finds]
+
+    def test_runtime_modules_all_parse(self):
+        """The gate actually covers the whole ISSUE module list."""
+        for rel in concurrency.RUNTIME_MODULES:
+            assert os.path.exists(os.path.join(REPO, rel)), rel
+
+
+# ---------------------------------------------------------------------------
+# wire-drift fixtures
+# ---------------------------------------------------------------------------
+
+
+class TestWireDriftFixtures:
+    def _texts(self):
+        with open(os.path.join(FIXTURES, "wire_mismatch.h")) as f:
+            hdr = f.read()
+        with open(os.path.join(FIXTURES, "wire_mismatch_py.txt")) as f:
+            py = f.read()
+        return hdr, py
+
+    def test_cpp_python_mismatch_caught(self):
+        hdr, py = self._texts()
+        finds = wiredrift.check_wire_tags(hdr, py)
+        by_symbol = {f.symbol: f for f in finds}
+        # STR exists only in the header
+        assert "STR" in by_symbol
+        assert "missing" in by_symbol["STR"].message
+        # F64 value disagrees (2 vs 7)
+        assert "F64" in by_symbol
+        assert "mismatch" in by_symbol["F64"].message
+        # NIL/I64 agree
+        assert "NIL" not in by_symbol and "I64" not in by_symbol
+
+    def test_matching_sides_pass(self):
+        hdr, _ = self._texts()
+        py = "_NIL = 0\n_I64 = 1\n_F64 = 2\n_STR = 3\n"
+        assert wiredrift.check_wire_tags(hdr, py) == []
+
+    def test_enum_scrape_implicit_values(self):
+        got = wiredrift.scrape_cpp_enum(
+            "enum class E { A = 3, B, C = 9, D };", "E"
+        )
+        assert got == {"A": 3, "B": 4, "C": 9, "D": 10}
+
+
+# ---------------------------------------------------------------------------
+# doc-drift fixtures
+# ---------------------------------------------------------------------------
+
+
+class TestDocDriftFixtures:
+    DOC = (
+        "## Metrics\n"
+        "| `tft_ok_total` | counter |\n"
+        "| `tft_ghost_total` | counter |\n"
+    )
+
+    def test_doc_only_and_code_only_both_flagged(self):
+        finds = docdrift.check_metric_catalog(
+            self.DOC, {"tft_ok_total", "tft_unseen_total"}
+        )
+        msgs = {f.symbol: f.message for f in finds}
+        assert "tft_ghost_total" in msgs  # documented, not registered
+        assert "tft_unseen_total" in msgs  # registered, not documented
+        assert "tft_ok_total" not in msgs
+
+    def test_fault_site_doc_table(self):
+        doc = "## Site catalog\n| `rpc.send` | x |\n| `ghost.site` | x |\n"
+        finds = docdrift.check_fault_sites_doc(doc, ("rpc.send", "cma.pull"))
+        symbols = {f.symbol for f in finds}
+        assert symbols == {"ghost.site", "cma.pull"}
+
+
+# ---------------------------------------------------------------------------
+# baseline semantics
+# ---------------------------------------------------------------------------
+
+
+class TestBaseline:
+    def _finding(self):
+        return Finding("blocking-under-lock", "x.py", 3, "C.m:sleep", "msg")
+
+    def test_suppression_matches_by_key_not_line(self):
+        f = self._finding()
+        bl = Baseline(suppressions=[{"key": f.key, "reason": "intentional"}])
+        active, suppressed, stale = bl.apply([f])
+        assert active == [] and suppressed == [f] and stale == []
+        # line number changes do not churn the baseline
+        f2 = Finding(f.rule, f.path, 99, f.symbol, f.message)
+        active, suppressed, stale = bl.apply([f2])
+        assert active == [] and stale == []
+
+    def test_stale_suppression_is_an_error(self, tmp_path):
+        """A baseline entry that no longer fires must fail the gate."""
+        f = self._finding()
+        bl = Baseline(suppressions=[
+            {"key": f.key, "reason": "live"},
+            {"key": "blocking-under-lock:gone.py:C.x:sleep",
+             "reason": "the code this matched was deleted"},
+        ])
+        active, suppressed, stale = bl.apply([f])
+        assert active == []
+        assert [e["key"] for e in stale] == [
+            "blocking-under-lock:gone.py:C.x:sleep"
+        ]
+        # end to end: the CLI exits 1 on the stale entry even though the
+        # tree itself is clean
+        path = tmp_path / "baseline.json"
+        real = Baseline.load(
+            os.path.join(REPO, "torchft_tpu", "analysis", "baseline.json")
+        )
+        doc = {"suppressions": real.suppressions + [
+            {"key": "blocking-under-lock:gone.py:C.x:sleep",
+             "reason": "stale on purpose"},
+        ]}
+        path.write_text(json.dumps(doc))
+        assert analysis_main(["--baseline", str(path)]) == 1
+
+    def test_baseline_entries_require_reason(self, tmp_path):
+        path = tmp_path / "b.json"
+        path.write_text(json.dumps({"suppressions": [{"key": "x"}]}))
+        with pytest.raises(ValueError):
+            Baseline.load(str(path))
+
+
+# ---------------------------------------------------------------------------
+# the repo gate (tier-1 wrapper)
+# ---------------------------------------------------------------------------
+
+
+class TestRepoGate:
+    def test_gate_clean_in_process(self):
+        """0 active findings, 0 stale suppressions on the real tree, via
+        the same code path as the CLI."""
+        per_analyzer = run_all()
+        baseline = Baseline.load(
+            os.path.join(REPO, "torchft_tpu", "analysis", "baseline.json")
+        )
+        allf = [f for finds in per_analyzer.values() for f in finds]
+        active, _suppressed, stale = baseline.apply(allf)
+        assert active == [], [f.render() for f in active]
+        assert stale == [], [e["key"] for e in stale]
+        # every suppression carries a real justification
+        for e in baseline.suppressions:
+            assert e["reason"] and "TODO" not in e["reason"]
+
+    def test_cli_exit_code_and_json(self):
+        """`python -m torchft_tpu.analysis --json` — the exact CI
+        invocation — exits 0 and reports ok=true."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchft_tpu.analysis", "--json"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["ok"] is True
+        assert set(doc["analyzers"]) == {"concurrency", "wiredrift",
+                                         "docdrift"}

@@ -2,13 +2,14 @@
 propagation, Chrome export schema), the collective flight recorder (ring
 wraparound, SIGUSR2 dump validity, deadline trigger), the step watchdog,
 the lighthouse cluster aggregation endpoints (/cluster.json, /trace),
-checkpoint-transport trace propagation, the parameter server's /metrics
-route, and the docs<->code drift check for the metric/event catalogs.
+checkpoint-transport trace propagation, and the parameter server's
+/metrics route. The docs<->code catalog drift checks that used to live
+here moved into ``python -m torchft_tpu.analysis`` (docdrift rules);
+``tests/test_analysis.py`` keeps them in tier-1 through the one gate.
 """
 
 import json
 import os
-import re
 import signal
 import threading
 import time
@@ -20,11 +21,9 @@ import pytest
 
 from torchft_tpu import telemetry
 from torchft_tpu.telemetry import read_trail
-from torchft_tpu.telemetry.events import CANONICAL_EVENTS, EventTrail
+from torchft_tpu.telemetry.events import EventTrail
 from torchft_tpu.telemetry.flight import FlightRecorder, StepWatchdog
 from torchft_tpu.telemetry.tracing import Tracer, read_spans
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 # ---------------------------------------------------------------------------
@@ -475,47 +474,3 @@ class TestTrailRotation:
         trail.close()
         assert not os.path.exists(str(tmp_path / "t.jsonl.1"))
 
-
-# ---------------------------------------------------------------------------
-# docs <-> code drift check
-# ---------------------------------------------------------------------------
-
-
-class TestCatalogDriftCheck:
-    DOC = os.path.join(REPO, "docs", "observability.md")
-
-    def _doc_text(self):
-        with open(self.DOC, encoding="utf-8") as f:
-            return f.read()
-
-    def test_metric_catalog_matches_registry(self):
-        """Every `tft_*` family documented in the catalog table exists in
-        the registry, and every registered family is documented — the
-        catalog cannot silently rot in either direction."""
-        doc_names = set(
-            re.findall(r"^\| `(tft_[a-z0-9_]+)`", self._doc_text(), re.M)
-        )
-        assert doc_names, "catalog table not found in docs/observability.md"
-        registry_names = {
-            name
-            for name in telemetry.REGISTRY.dump()
-            if name.startswith("tft_")
-        }
-        assert doc_names - registry_names == set(), (
-            f"documented but not registered: {sorted(doc_names - registry_names)}"
-        )
-        assert registry_names - doc_names == set(), (
-            f"registered but not documented: {sorted(registry_names - doc_names)}"
-        )
-
-    def test_event_table_matches_canonical_kinds(self):
-        text = self._doc_text()
-        start = text.index("Event kinds and fields:")
-        section = text[start:]
-        end = section.index("\n## ")
-        section = section[:end]
-        doc_kinds = set(re.findall(r"^\| `([a-z0-9_]+)`", section, re.M))
-        assert doc_kinds == set(CANONICAL_EVENTS), (
-            f"doc-only: {sorted(doc_kinds - set(CANONICAL_EVENTS))}, "
-            f"code-only: {sorted(set(CANONICAL_EVENTS) - doc_kinds)}"
-        )

@@ -51,6 +51,7 @@ def compute_quorum_results(
     quorum: Dict[str, Any], replica_id: str, rank: int
 ) -> Dict[str, Any]: ...
 def cma_read(pid: int, addr: int, n: int) -> bytes: ...
+def cma_read_into(pid: int, addr: int, view: memoryview) -> None: ...
 
 class DataPlaneError(ConnectionError):
     peer_rank: int

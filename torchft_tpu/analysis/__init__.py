@@ -1,0 +1,50 @@
+"""torchft_tpu static-analysis suite — one gate for the invariants that
+the test tier can't see.
+
+Run it as ``python -m torchft_tpu.analysis`` (single exit code, human or
+``--json`` output, checked-in baseline at ``analysis/baseline.json``).
+Three analyzers:
+
+* :mod:`~torchft_tpu.analysis.concurrency` — AST concurrency lint over
+  the FT runtime modules (lock-order cycles, blocking/callback calls
+  under locks, guarded-by annotations for cross-thread state,
+  ``Condition.wait`` predicate loops, thread hygiene);
+* :mod:`~torchft_tpu.analysis.wiredrift` — C++ ↔ Python protocol drift
+  (wire tags, status codes, RPC opcodes, ``TORCHFT_FI_*`` knobs, fault
+  site labels, ``.pyi`` stub coverage);
+* :mod:`~torchft_tpu.analysis.docdrift` — the bidirectional doc/registry
+  catalogs (metrics, events, fault sites).
+
+See ``docs/static_analysis.md`` for the rule catalog and the baseline
+workflow.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from torchft_tpu.analysis.base import (
+    Baseline,
+    DEFAULT_BASELINE,
+    Finding,
+    repo_root,
+)
+
+__all__ = [
+    "Baseline",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "repo_root",
+    "run_all",
+]
+
+
+def run_all(root: Optional[str] = None) -> Dict[str, List[Finding]]:
+    """Run every analyzer; returns findings per analyzer (pre-baseline)."""
+    from torchft_tpu.analysis import concurrency, docdrift, wiredrift
+
+    return {
+        "concurrency": concurrency.run(root),
+        "wiredrift": wiredrift.run(root),
+        "docdrift": docdrift.run(root),
+    }

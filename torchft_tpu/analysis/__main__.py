@@ -1,0 +1,93 @@
+"""CLI: ``python -m torchft_tpu.analysis``.
+
+Exit codes: 0 = clean (all findings baselined, no stale suppressions),
+1 = active findings and/or stale baseline entries, 2 = analyzer crash.
+
+``--json`` emits a machine-readable report; ``--update-baseline`` writes
+every currently-active finding into the baseline (each entry still needs
+a human to replace the placeholder reason before review)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from torchft_tpu.analysis import Baseline, DEFAULT_BASELINE, run_all
+from torchft_tpu.analysis.base import Finding
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="torchft_tpu.analysis",
+        description="project static-analysis gate (concurrency lint, "
+        "wire drift, doc drift)",
+    )
+    ap.add_argument("--root", default=None, help="repo root (default: auto)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline/suppression file")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write active findings into the baseline with "
+                    "placeholder reasons (then go justify them)")
+    args = ap.parse_args(argv)
+
+    try:
+        per_analyzer = run_all(args.root)
+        baseline = Baseline.load(args.baseline)
+    except Exception as e:  # noqa: BLE001 — analyzer crash is exit 2
+        print(f"analysis failed: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    all_findings: List[Finding] = [
+        f for finds in per_analyzer.values() for f in finds
+    ]
+    active, suppressed, stale = baseline.apply(all_findings)
+
+    if args.update_baseline and active:
+        seen = {e["key"] for e in baseline.suppressions}
+        for f in active:
+            if f.key not in seen:
+                seen.add(f.key)
+                baseline.suppressions.append({
+                    "key": f.key,
+                    "reason": "TODO: justify or fix",
+                })
+        baseline.save(args.baseline)
+        print(f"baseline updated: +{len(active)} entries "
+              f"({args.baseline}) — now justify each reason")
+        return 1
+
+    if args.as_json:
+        print(json.dumps({
+            "analyzers": {
+                name: [f.to_dict() for f in finds]
+                for name, finds in per_analyzer.items()
+            },
+            "active": [f.to_dict() for f in active],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "stale_suppressions": stale,
+            "ok": not active and not stale,
+        }, indent=2))
+    else:
+        for name, finds in per_analyzer.items():
+            n_active = sum(1 for f in finds if f in active)
+            print(f"-- {name}: {len(finds)} finding(s), "
+                  f"{n_active} active, "
+                  f"{len(finds) - n_active} baselined")
+        for f in active:
+            print(f"ACTIVE   {f.render()}")
+        for e in stale:
+            print(f"STALE    baseline entry matches nothing: {e['key']} "
+                  f"(reason was: {e['reason']}) — remove it")
+        if not active and not stale:
+            print(f"clean: {len(suppressed)} baselined finding(s), "
+                  "0 active, 0 stale")
+
+    return 1 if (active or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

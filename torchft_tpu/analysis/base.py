@@ -1,0 +1,114 @@
+"""Shared plumbing for the static-analysis suite: findings, baseline.
+
+One gate, one format: every analyzer (concurrency lint, wire drift, doc
+drift) emits :class:`Finding` records; ``__main__`` merges them against the
+checked-in baseline/suppression file and produces a single exit code.
+
+A suppression matches findings by **key** (``rule:path:symbol`` — line
+numbers deliberately excluded so routine edits don't churn the baseline).
+Every entry must carry a ``reason`` and must still match at least one live
+finding: an entry that no longer fires is *stale* and is itself an error,
+so the baseline can only shrink or stay justified — never rot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["Finding", "Baseline", "repo_root", "DEFAULT_BASELINE"]
+
+
+def repo_root() -> str:
+    """The repository root (two levels above this package)."""
+    return os.path.normpath(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+    )
+
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+@dataclass
+class Finding:
+    """One analyzer hit.
+
+    ``rule``  — stable rule id (e.g. ``lock-order-cycle``).
+    ``path``  — repo-relative file path.
+    ``line``  — 1-based line (0 for whole-file/catalog findings).
+    ``symbol``— the offending symbol (function, attribute, constant name);
+                part of the suppression key, so keep it stable.
+    ``message``— human explanation, with enough detail to fix or justify.
+    """
+
+    rule: str
+    path: str
+    line: int
+    symbol: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "key": self.key,
+        }
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.symbol}: {self.message}"
+
+
+@dataclass
+class Baseline:
+    """Checked-in suppression file (``analysis/baseline.json``)."""
+
+    suppressions: List[Dict[str, str]] = field(default_factory=list)
+    path: Optional[str] = None
+
+    @staticmethod
+    def load(path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return Baseline(path=path)
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        entries = doc.get("suppressions", [])
+        for e in entries:
+            if "key" not in e or "reason" not in e:
+                raise ValueError(
+                    f"baseline entry must carry 'key' and 'reason': {e}"
+                )
+        return Baseline(suppressions=entries, path=path)
+
+    def save(self, path: Optional[str] = None) -> None:
+        path = path or self.path
+        assert path is not None
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(
+                {"suppressions": self.suppressions}, f, indent=2, sort_keys=True
+            )
+            f.write("\n")
+
+    def apply(
+        self, findings: List[Finding]
+    ) -> Tuple[List[Finding], List[Finding], List[Dict[str, str]]]:
+        """Split findings into (active, suppressed) and return the stale
+        suppression entries (keys that matched nothing — themselves
+        errors, so dead baseline entries can't accumulate)."""
+        keys = {e["key"] for e in self.suppressions}
+        active = [f for f in findings if f.key not in keys]
+        suppressed = [f for f in findings if f.key in keys]
+        live = {f.key for f in suppressed}
+        stale = [e for e in self.suppressions if e["key"] not in live]
+        return active, suppressed, stale

@@ -1,0 +1,129 @@
+"""Doc/registry drift rules — the bidirectional catalog checks.
+
+Ported from the former ``tests/test_tracing.py::TestCatalogDriftCheck``
+into the analyzer so there is ONE gate and one baseline format for every
+drift class (a thin pytest wrapper keeps them in tier-1):
+
+``metric-catalog-drift``
+    Every ``tft_*`` family documented in ``docs/observability.md`` exists
+    in the live telemetry registry, and every registered family is
+    documented.
+
+``event-catalog-drift``
+    The event-kind table in ``docs/observability.md`` matches
+    ``telemetry.events.CANONICAL_EVENTS`` exactly.
+
+``fault-site-doc-drift``
+    The site catalog table in ``docs/fault_injection.md`` matches
+    ``faultinject.core.SITES`` exactly (new in this PR — the site list
+    had no doc gate before).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional
+
+from torchft_tpu.analysis.base import Finding, repo_root
+
+__all__ = ["run", "check_metric_catalog", "check_event_catalog",
+           "check_fault_sites_doc"]
+
+
+def _read(root: str, rel: str) -> str:
+    with open(os.path.join(root, rel), encoding="utf-8") as f:
+        return f.read()
+
+
+def check_metric_catalog(doc_text: str, registry_names: set) -> List[Finding]:
+    doc_names = set(re.findall(r"^\| `(tft_[a-z0-9_]+)`", doc_text, re.M))
+    finds: List[Finding] = []
+    if not doc_names:
+        return [Finding(
+            "metric-catalog-drift", "docs/observability.md", 0, "<table>",
+            "metric catalog table not found",
+        )]
+    for n in sorted(doc_names - registry_names):
+        finds.append(Finding(
+            "metric-catalog-drift", "docs/observability.md", 0, n,
+            "documented metric family is not registered",
+        ))
+    for n in sorted(registry_names - doc_names):
+        finds.append(Finding(
+            "metric-catalog-drift", "docs/observability.md", 0, n,
+            "registered metric family is not documented in the catalog",
+        ))
+    return finds
+
+
+def check_event_catalog(doc_text: str, canonical: tuple) -> List[Finding]:
+    try:
+        start = doc_text.index("Event kinds and fields:")
+    except ValueError:
+        return [Finding(
+            "event-catalog-drift", "docs/observability.md", 0, "<table>",
+            "event-kinds table not found",
+        )]
+    section = doc_text[start:]
+    end = section.find("\n## ")
+    if end >= 0:
+        section = section[:end]
+    doc_kinds = set(re.findall(r"^\| `([a-z0-9_]+)`", section, re.M))
+    finds: List[Finding] = []
+    for n in sorted(doc_kinds - set(canonical)):
+        finds.append(Finding(
+            "event-catalog-drift", "docs/observability.md", 0, n,
+            "documented event kind missing from CANONICAL_EVENTS",
+        ))
+    for n in sorted(set(canonical) - doc_kinds):
+        finds.append(Finding(
+            "event-catalog-drift", "docs/observability.md", 0, n,
+            "CANONICAL_EVENTS kind missing from the docs table",
+        ))
+    return finds
+
+
+def check_fault_sites_doc(doc_text: str, sites: tuple) -> List[Finding]:
+    try:
+        start = doc_text.index("## Site catalog")
+    except ValueError:
+        return [Finding(
+            "fault-site-doc-drift", "docs/fault_injection.md", 0, "<table>",
+            "site catalog section not found",
+        )]
+    section = doc_text[start:]
+    end = section.find("\n## ", 1)
+    if end >= 0:
+        section = section[:end]
+    doc_sites = set(re.findall(r"^\| `([a-z_.]+)`", section, re.M))
+    finds: List[Finding] = []
+    for n in sorted(doc_sites - set(sites)):
+        finds.append(Finding(
+            "fault-site-doc-drift", "docs/fault_injection.md", 0, n,
+            "documented injection site missing from faultinject.core.SITES",
+        ))
+    for n in sorted(set(sites) - doc_sites):
+        finds.append(Finding(
+            "fault-site-doc-drift", "docs/fault_injection.md", 0, n,
+            "SITES entry missing from the docs site catalog",
+        ))
+    return finds
+
+
+def run(root: Optional[str] = None) -> List[Finding]:
+    root = root or repo_root()
+    from torchft_tpu import telemetry
+    from torchft_tpu.faultinject.core import SITES
+    from torchft_tpu.telemetry.events import CANONICAL_EVENTS
+
+    obs = _read(root, "docs/observability.md")
+    fi = _read(root, "docs/fault_injection.md")
+    registry_names = {
+        name for name in telemetry.REGISTRY.dump() if name.startswith("tft_")
+    }
+    out: List[Finding] = []
+    out += check_metric_catalog(obs, registry_names)
+    out += check_event_catalog(obs, CANONICAL_EVENTS)
+    out += check_fault_sites_doc(fi, SITES)
+    return out

@@ -20,9 +20,9 @@ class RWLock:
     def __init__(self, timeout: Optional[float] = None) -> None:
         self._timeout = timeout
         self._cond = threading.Condition()
-        self._readers = 0
-        self._writer = False
-        self._want_write = 0  # pending writers block new readers
+        self._readers = 0  # guarded-by: _cond
+        self._writer = False  # guarded-by: _cond
+        self._want_write = 0  # pending writers block new readers; guarded-by: _cond
 
     def _wait(self, predicate) -> None:
         ok = self._cond.wait_for(predicate, timeout=self._timeout)

@@ -407,7 +407,7 @@ class CollectivesTcp(Collectives):
         self._rank = -1
         self._world = 0
         self._generation = 0
-        self._peers: Dict[int, _Peer] = {}
+        self._peers: Dict[int, _Peer] = {}  # guarded-by: _peers_lock
         self._peers_lock = threading.Lock()
         self._listener: Optional[socket.socket] = None
         self._acceptor: Optional[threading.Thread] = None
@@ -451,7 +451,8 @@ class CollectivesTcp(Collectives):
         self._store.set(f"coll/addr/{rank}", f"{self._hostname}:{port}")
 
         self._acceptor = threading.Thread(
-            target=self._accept_loop, args=(listener, gen), daemon=True
+            target=self._accept_loop, args=(listener, gen), daemon=True,
+            name="tft_accept",
         )
         self._acceptor.start()
         # Eagerly establish the full mesh so configure() surfaces

@@ -79,22 +79,31 @@ class _Epoch:
         self.sends: Dict[Tuple[int, int, int], deque] = {}
         self.recvs: Dict[Tuple[int, int, int], deque] = {}
 
-    def fail_pending(self, exc: Exception) -> None:
-        """Called under self.lock — resolve every waiter with ``exc``."""
+    def fail_pending(self, exc: Exception) -> List[Future]:
+        """Called under self.lock — detach every waiter and return the
+        doomed futures for the CALLER to resolve AFTER releasing the
+        lock. Resolving them in here ran arbitrary continuation callbacks
+        (timeout-chain copies, flight-recorder completions, user ``then``
+        chains) inside the epoch lock, so a continuation that re-entered
+        the collectives deadlocked [found by the analysis gate:
+        callback-under-lock]."""
         self.dead = exc
+        doomed: List[Future] = []
         for op in self.ops.values():
-            for fut in op.futures.values():
-                fut.set_exception(exc)
+            doomed.extend(op.futures.values())
         self.ops.clear()
         for waiters in self.recvs.values():
-            for fut, _arr in waiters:
-                fut.set_exception(exc)
+            doomed.extend(fut for fut, _arr in waiters)
         self.recvs.clear()
         self.sends.clear()
+        return doomed
 
 
 _REGISTRY: Dict[str, _Epoch] = {}
 _REGISTRY_LOCK = threading.Lock()
+
+# sentinel distinguishing "no buffered send matched" from a buffered None
+_NOTHING = object()
 
 
 def _devices_and_spec(arr) -> Tuple[np.ndarray, Tuple[str, ...], Any]:
@@ -229,17 +238,20 @@ class CollectivesDevice(Collectives):
         ep, self._epoch = self._epoch, None
         if ep is None:
             return
+        exc = RuntimeError("collectives reconfigured before op completed")
         with ep.lock:
             ep.left.add(self._rank)
             # a departing member strands every in-flight op of the epoch —
-            # resolve waiters now (the socket-shutdown analogue)
-            ep.fail_pending(
-                RuntimeError("collectives reconfigured before op completed")
-            )
+            # detach the waiters now (the socket-shutdown analogue)
+            doomed = ep.fail_pending(exc)
             # delete once every member that ever joined has left — members
             # that never joined (peer crashed before configure) must not
             # pin the epoch in the registry forever
             all_gone = ep.left >= ep.joined
+        # resolve outside the lock: continuations run inline on this
+        # thread and may re-enter the collectives
+        for fut in doomed:
+            fut.set_exception(exc)
         if all_gone:
             with _REGISTRY_LOCK:
                 if _REGISTRY.get(ep.key) is ep:
@@ -289,30 +301,41 @@ class CollectivesDevice(Collectives):
         )
         fut: Future = Future()
         run_op: Optional[_Op] = None
+        dead: Optional[Exception] = None
+        desync: Optional[RuntimeError] = None
+        doomed: List[Future] = []
         with ep.lock:
             if ep.dead is not None:
-                fut.set_exception(ep.dead)
-                telemetry.FLIGHT.record_complete(fid, error=ep.dead)
-                return Work(future_timeout(fut, self._timeout))
-            op = ep.ops.get(tag)
-            if op is None:
-                op = _Op(kind, ep.world, meta)
-                ep.ops[tag] = op
-            if op.kind != kind or op.meta != meta:
-                exc = RuntimeError(
-                    f"collective desync: op {tag} is {op.kind}{op.meta}, "
-                    f"this group issued {kind}{meta}"
-                )
-                # a desynced epoch can never make progress — fail everyone
-                # now instead of stranding the other groups' waiters
-                ep.fail_pending(exc)
-                telemetry.FLIGHT.record_complete(fid, error=exc)
-                raise exc
-            op.inputs[self._rank] = payload
-            op.futures[self._rank] = fut
-            if len(op.inputs) == op.world:
-                del ep.ops[tag]
-                run_op = op
+                dead = ep.dead
+            else:
+                op = ep.ops.get(tag)
+                if op is None:
+                    op = _Op(kind, ep.world, meta)
+                    ep.ops[tag] = op
+                if op.kind != kind or op.meta != meta:
+                    desync = RuntimeError(
+                        f"collective desync: op {tag} is {op.kind}{op.meta}, "
+                        f"this group issued {kind}{meta}"
+                    )
+                    # a desynced epoch can never make progress — fail
+                    # everyone instead of stranding the other groups'
+                    # waiters (futures resolved below, outside the lock)
+                    doomed = ep.fail_pending(desync)
+                else:
+                    op.inputs[self._rank] = payload
+                    op.futures[self._rank] = fut
+                    if len(op.inputs) == op.world:
+                        del ep.ops[tag]
+                        run_op = op
+        if dead is not None:
+            fut.set_exception(dead)
+            telemetry.FLIGHT.record_complete(fid, error=dead)
+            return Work(future_timeout(fut, self._timeout))
+        if desync is not None:
+            for f in doomed:
+                f.set_exception(desync)
+            telemetry.FLIGHT.record_complete(fid, error=desync)
+            raise desync
         if run_op is not None:
             self._compute(run_op)
         out = future_timeout(fut, self._timeout)
@@ -392,15 +415,23 @@ class CollectivesDevice(Collectives):
         assert ep is not None, "configure() must be called first"
         key = (self._rank, dst, tag)
         arr = _as_device(arr)
+        matched: Optional[Future] = None
         with ep.lock:
             if ep.dead is not None:
-                return Work(Future.failed(ep.dead))
-            waiters = ep.recvs.get(key)
-            if waiters:
-                fut, _target = waiters.popleft()
-                fut.set_result(arr)
+                dead = ep.dead
             else:
-                ep.sends.setdefault(key, deque()).append(arr)
+                dead = None
+                waiters = ep.recvs.get(key)
+                if waiters:
+                    matched, _target = waiters.popleft()
+                else:
+                    ep.sends.setdefault(key, deque()).append(arr)
+        if dead is not None:
+            return Work(Future.failed(dead))
+        if matched is not None:
+            # resolve outside the lock: the receiver's `place` continuation
+            # (and any user `then`) runs inline on this thread
+            matched.set_result(arr)
         return Work.completed(None)  # buffered send, like TCP's sendall
 
     def recv(self, arr: Any, src: int, tag: int = 0) -> Work:
@@ -408,15 +439,22 @@ class CollectivesDevice(Collectives):
         assert ep is not None, "configure() must be called first"
         key = (src, self._rank, tag)
         fut: Future = Future()
+        got = _NOTHING
         with ep.lock:
             if ep.dead is not None:
-                fut.set_exception(ep.dead)
-                return Work(future_timeout(fut, self._timeout))
-            buffered = ep.sends.get(key)
-            if buffered:
-                fut.set_result(buffered.popleft())
+                dead = ep.dead
             else:
-                ep.recvs.setdefault(key, deque()).append((fut, arr))
+                dead = None
+                buffered = ep.sends.get(key)
+                if buffered:
+                    got = buffered.popleft()
+                else:
+                    ep.recvs.setdefault(key, deque()).append((fut, arr))
+        if dead is not None:
+            fut.set_exception(dead)
+            return Work(future_timeout(fut, self._timeout))
+        if got is not _NOTHING:
+            fut.set_result(got)  # outside the lock — continuations inline
 
         def place(f: Future) -> Any:
             # received payload keeps its device placement; in-place numpy

@@ -85,6 +85,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "SITES",
+    "NATIVE_SITES",
     "ACTIONS",
     "ENV_CORRUPTION_SIGNATURES",
     "CORRUPTION_SIGNAL_RCS",
@@ -112,6 +113,20 @@ SITES = (
     "quorum.reply",
     "commit.vote",
     "future.deadline",
+)
+
+# Site labels the NATIVE plane's evidence records may carry (the
+# `fi::write_evidence` / `fi::kill_self` call sites in native/*.cc|h).
+# conftest's injection-evidence check and the scenario runner treat these
+# exactly like SITES when attributing a death to a scheduled injection;
+# `python -m torchft_tpu.analysis` (wiredrift: fault-site-drift) keeps
+# this tuple and the native call sites from drifting apart.
+NATIVE_SITES = (
+    "cma.desc",
+    "cma.pull",
+    "commit.vote",
+    "dp.hop",
+    "rpc.send",
 )
 
 ACTIONS = ("delay", "drop", "error", "torn", "kill")

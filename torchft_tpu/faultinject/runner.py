@@ -29,17 +29,19 @@ documented environmental-corruption signature but NO evidence marks the
 scenario ``environmental`` (recorded, not a failure — see ROADMAP open
 item). Anything else fails the run.
 
-``--sanitize`` rebuilds the native plane under ASan (``make -C native
-asan``), runs a short matrix with the sanitized core LD_PRELOAD-loaded
-into every worker, and fails on any sanitizer report — the repeatable
-form of the ROADMAP's heap-corruption hunt.
+``--sanitize[=asan|tsan]`` rebuilds the native plane under the named
+sanitizer (``make -C native asan``/``tsan``), runs a short matrix with
+the sanitized core LD_PRELOAD-loaded into every worker, and fails on any
+sanitizer report — ASan is the repeatable form of the ROADMAP's
+heap-corruption hunt; TSan is its concurrency complement (the dynamic
+side of ``python -m torchft_tpu.analysis``'s static lock rules).
 
 Usage::
 
     python -m torchft_tpu.faultinject.runner --quick
     python -m torchft_tpu.faultinject.runner --scenario torn_cma_pull
-    make -C native asan && \
-        python -m torchft_tpu.faultinject.runner --sanitize --quick
+    python -m torchft_tpu.faultinject.runner --sanitize --quick
+    python -m torchft_tpu.faultinject.runner --sanitize=tsan --quick
 """
 
 from __future__ import annotations
@@ -398,50 +400,75 @@ def run_scenario(scn: Scenario, workdir: str, steps: int = 16,
 # ---------------------------------------------------------------------------
 
 
-def _libasan_path() -> str:
+def _libsan_path(runtime: str) -> str:
     cxx = os.environ.get("CXX", "g++")
+    name = f"lib{runtime}.so"
     out = subprocess.run(
-        [cxx, "-print-file-name=libasan.so"],
+        [cxx, "-print-file-name=" + name],
         capture_output=True, text=True, check=True,
     ).stdout.strip()
-    if not out or out == "libasan.so":
-        raise RuntimeError("libasan.so not found (is gcc installed?)")
+    if not out or out == name:
+        raise RuntimeError(f"{name} not found (is gcc installed?)")
     return out
 
 
-def build_sanitized() -> str:
-    """``make -C native asan``; returns the sanitized .so path."""
+def build_sanitized(kind: str) -> str:
+    """``make -C native <kind>``; returns the sanitized .so path."""
     subprocess.run(
-        ["make", "-C", os.path.join(REPO, "native"), "asan"], check=True
+        ["make", "-C", os.path.join(REPO, "native"), kind], check=True
     )
-    lib = os.path.join(REPO, "torchft_tpu", "_native", "libtftcore_asan.so")
+    lib = os.path.join(
+        REPO, "torchft_tpu", "_native", f"libtftcore_{kind}.so"
+    )
     assert os.path.exists(lib), lib
     return lib
 
 
-def sanitize_env(outdir: str) -> Dict[str, str]:
-    lib = build_sanitized()
-    return {
+def sanitize_env(outdir: str, kind: str) -> Dict[str, str]:
+    lib = build_sanitized(kind)
+    env = {
         "TORCHFT_NATIVE_LIB": lib,
-        "LD_PRELOAD": _libasan_path(),
+        "LD_PRELOAD": _libsan_path(kind),
+    }
+    if kind == "asan":
         # leaks are expected from the interpreter itself; we hunt
         # corruption (use-after-free, overflow), not leaks
-        "ASAN_OPTIONS": (
+        env["ASAN_OPTIONS"] = (
             "detect_leaks=0:abort_on_error=1:handle_abort=1:"
             f"log_path={os.path.join(outdir, 'asan')}"
-        ),
-    }
+        )
+    else:
+        # exitcode=0: a report must not kill the worker mid-scenario (the
+        # matrix's bit-identity invariant still has to be checked); the
+        # gate is the log scan below. Only the native .so is instrumented
+        # — the interpreter's own accesses are invisible to TSan, but its
+        # pthread mutex/cond use IS intercepted via LD_PRELOAD, so
+        # happens-before through the GIL and ctypes boundaries is tracked
+        # and native-plane races attribute to instrumented frames.
+        env["TSAN_OPTIONS"] = (
+            "exitcode=0:report_thread_leaks=0:second_deadlock_stack=1:"
+            f"log_path={os.path.join(outdir, 'tsan')}"
+        )
+    return env
 
 
-def scan_asan_reports(outdir: str) -> List[str]:
+_SAN_REPORT_MARKERS = (
+    "ERROR: AddressSanitizer",
+    "WARNING: ThreadSanitizer",
+    "ERROR: ThreadSanitizer",
+    "runtime error:",
+)
+
+
+def scan_san_reports(outdir: str, kind: str) -> List[str]:
     hits = []
-    for path in sorted(glob.glob(os.path.join(outdir, "asan.*"))):
+    for path in sorted(glob.glob(os.path.join(outdir, f"{kind}.*"))):
         try:
             with open(path, errors="replace") as f:
                 text = f.read()
         except OSError:
             continue
-        if "ERROR: AddressSanitizer" in text or "runtime error:" in text:
+        if any(m in text for m in _SAN_REPORT_MARKERS):
             hits.append(path)
     return hits
 
@@ -461,9 +488,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--quick", action="store_true",
                     help="short matrix: the quick-subset scenarios, "
                     "fewer steps")
-    ap.add_argument("--sanitize", action="store_true",
-                    help="rebuild the native plane under ASan and fail "
-                    "on any sanitizer report")
+    ap.add_argument("--sanitize", nargs="?", const="asan", default=None,
+                    choices=("asan", "tsan"), metavar="{asan,tsan}",
+                    help="rebuild the native plane under the named "
+                    "sanitizer (default asan) and fail on any report")
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-scenario wall-clock cap (seconds)")
@@ -501,12 +529,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         # __cxa_throw interceptor CHECK-fails in jaxlib's jit tracing) —
         # the numpy worker drives the identical native-plane/RPC/heal
         # path, which is where every corruption suspect lives
-        extra_env = sanitize_env(outdir)
+        extra_env = sanitize_env(outdir, args.sanitize)
         worker_argv = [
             sys.executable, "-m", "torchft_tpu.faultinject._san_worker"
         ]
-        print(f"sanitizer armed: {extra_env['TORCHFT_NATIVE_LIB']} "
-              "(jax-free numpy worker)")
+        print(f"sanitizer armed ({args.sanitize}): "
+              f"{extra_env['TORCHFT_NATIVE_LIB']} (jax-free numpy worker)")
 
     results: List[Result] = []
     for scn in selected:
@@ -525,22 +553,22 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     report = {
         "steps": steps,
-        "sanitize": bool(args.sanitize),
+        "sanitize": args.sanitize or False,
         "results": [r.__dict__ for r in results],
     }
     failed = [r for r in results if r.status == "failed"]
     if args.sanitize:
-        hits = scan_asan_reports(outdir)
-        report["asan_reports"] = hits
+        hits = scan_san_reports(outdir, args.sanitize)
+        report["sanitizer_reports"] = hits
         if hits:
-            print(f"ASAN REPORTS ({len(hits)}):")
+            print(f"{args.sanitize.upper()} REPORTS ({len(hits)}):")
             for h in hits:
                 print(f"  {h}")
                 with open(h, errors="replace") as f:
                     head = f.read(2000)
                 print("    " + "\n    ".join(head.splitlines()[:25]))
             failed.append(Result("sanitizer", "failed",
-                                 f"{len(hits)} ASan report(s)"))
+                                 f"{len(hits)} {args.sanitize} report(s)"))
         else:
             print("sanitizer: no reports")
     with open(os.path.join(outdir, "faultmatrix.json"), "w") as f:

@@ -203,6 +203,9 @@ class Manager:
         """
         self._load_state_dict = load_state_dict
         self._user_state_dict = state_dict
+        # unguarded-ok: quorum-thread handoff — staged by the quorum
+        #   thread during heal, applied on the main thread strictly after
+        #   wait_quorum() (asserted in _apply_pending_state_dict)
         self._pending_state_dict: Optional[Dict[str, object]] = None
         self._use_async_quorum = use_async_quorum
         self._timeout = timeout
@@ -228,7 +231,7 @@ class Manager:
         self._executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="async_quorum"
         )
-        self._quorum_future: Optional[concurrent.futures.Future] = None
+        self._quorum_future: Optional[concurrent.futures.Future] = None  # guarded-by: _qf_lock
         # guards _quorum_future replacement: the death watch may submit a
         # premature re-quorum from its monitor thread (see _on_peer_death)
         self._qf_lock = threading.Lock()
@@ -266,14 +269,31 @@ class Manager:
         self._logger = _ManagerLogger(self, replica_id or "", rank)
         self._replica_id = replica_id or ""
 
+        # unguarded-ok: quorum-thread handoff — the caller's wait_quorum()
+        #   barrier (and the commit drain) orders the quorum thread's heal
+        #   write of _step against main-thread reads/increments
         self._step = 0
         self._step_label = 0  # physical-step coordinate (see start_quorum)
         self._quorum_id = -1
-        self._participant_ids: List[str] = []  # replica_rank -> replica_id
-        self._evicted: set = set()  # victims already reported this epoch
+        # _participant_ids/_evicted cross three threads: the quorum thread
+        # replaces membership each epoch, while the death-watch monitor and
+        # main-thread error paths report evictions. The lock closes the
+        # check-then-add race (a victim double-reported = a wasted
+        # lighthouse liveness probe + duplicate trail records) and keeps
+        # attribution reading a consistent (ids, evicted) pair
+        # [found by the analysis gate: unguarded-shared-write].
+        self._evict_lock = threading.Lock()
+        self._participant_ids: List[str] = []  # guarded-by: _evict_lock
+        self._evicted: set = set()  # guarded-by: _evict_lock
         # (plane_generation, participant_ids) armed for the death watch
         self._death_watch_snapshot: Optional[Tuple[int, List[str]]] = None
+        # unguarded-ok: issue-time latch — written by the main thread and
+        #   op-callback/quorum threads, read at the commit barrier after
+        #   the pending-work drain (last-write-wins is the latch contract)
         self._commit_failures = 0  # pending data-plane flush request
+        # unguarded-ok: error latch — any thread may latch, the commit
+        #   barrier reads after draining pending work; a racing latch only
+        #   changes WHICH error aborts the step, never whether it aborts
         self._errored: Optional[Exception] = None
         self._errored_epoch = -1  # quorum_id whose plane produced _errored
         self._step_epochs: set = set()  # quorum_ids this step's ops ran on
@@ -286,9 +306,15 @@ class Manager:
         # at the next step boundary (the "<1 step" recovery envelope).
         if hasattr(collectives, "set_death_watch"):
             collectives.set_death_watch(self._on_peer_death)
+        # unguarded-ok: quorum-thread handoff — wait_quorum() (async mode)
+        #   or the synchronous start_quorum path is the happens-before
+        #   barrier between the quorum thread's writes and main reads
         self._healing = False
+        # unguarded-ok: quorum-thread handoff — same barrier as _healing
         self._group_healing = False
         self._pending_work: List[Future] = []
+        # unguarded-ok: quorum-thread handoff — heal-path restore on the
+        #   quorum thread, increments on the main thread post-drain
         self._batches_committed = 0
 
         # Pipelined commit (see docs/commit_pipeline.md): the vote RPC for
@@ -462,28 +488,50 @@ class Manager:
             shrink_only=shrink_only,
         )
 
-        # hold the lock across wait+replace: a death-watch submission
-        # sliding in between would be silently overwritten (its exception
-        # never observed, a duplicate lighthouse RPC from this replica)
-        with self._qf_lock:
-            if self._quorum_future is not None:
-                try:
-                    self._quorum_future.result()
-                except Exception as e:  # noqa: BLE001
-                    # the failure already surfaced to the caller through
-                    # wait_quorum/allreduce/should_commit on the step that
-                    # scheduled it; calling start_quorum again IS the retry —
-                    # start fresh instead of re-raising history forever
-                    self._logger.warn(
-                        f"previous quorum attempt failed ({e}); retrying"
+        # Replace-under-lock, wait-outside-lock. Replacement only happens
+        # after observing a DONE future under the lock, so a death-watch
+        # submission can never be silently overwritten (its exception
+        # unobserved, a duplicate lighthouse RPC from this replica) — but
+        # the waiting itself must not hold _qf_lock: the previous future
+        # can be an in-flight death-watch re-quorum long-poll, and an
+        # earlier version that held the lock across .result() blocked
+        # _on_peer_death's monitor thread (stalling dead-peer eviction
+        # reports) for up to quorum_timeout [found by the analysis gate:
+        # blocking-under-lock].
+        while True:
+            with self._qf_lock:
+                prev = self._quorum_future
+                if prev is None or prev.done():
+                    if prev is not None:
+                        try:
+                            exc = prev.exception()  # done ⇒ returns now
+                        except Exception as e:  # noqa: BLE001 — cancelled
+                            exc = e
+                        if exc is not None:
+                            # the failure already surfaced to the caller
+                            # through wait_quorum/allreduce/should_commit
+                            # on the step that scheduled it; calling
+                            # start_quorum again IS the retry — start
+                            # fresh instead of re-raising history forever
+                            self._logger.warn(
+                                f"previous quorum attempt failed ({exc}); "
+                                "retrying"
+                            )
+                    self._last_quorum_args = (allow_heal, shrink_only, timeout)
+                    self._quorum_future = self._executor.submit(
+                        self._async_quorum,
+                        allow_heal=allow_heal,
+                        shrink_only=shrink_only,
+                        quorum_timeout=timeout or self._quorum_timeout,
                     )
-            self._last_quorum_args = (allow_heal, shrink_only, timeout)
-            self._quorum_future = self._executor.submit(
-                self._async_quorum,
-                allow_heal=allow_heal,
-                shrink_only=shrink_only,
-                quorum_timeout=timeout or self._quorum_timeout,
-            )
+                    break
+            # an in-flight previous attempt: wait it out with the lock
+            # RELEASED, then re-check — a death-watch submission landing
+            # in between is observed (not clobbered) by the next pass
+            try:
+                prev.result()
+            except Exception:  # noqa: BLE001 — consumed under the lock above
+                pass
         if not self._use_async_quorum:
             self.wait_quorum()
             if self._healing:
@@ -567,9 +615,10 @@ class Manager:
             ):
                 self._participating_rank = None
 
-        prev_participants = self._participant_ids
-        self._participant_ids = quorum.participant_ids
-        self._evicted.clear()
+        with self._evict_lock:
+            prev_participants = self._participant_ids
+            self._participant_ids = quorum.participant_ids
+            self._evicted.clear()
 
         telemetry.PARTICIPANTS.set(self._participating_world_size)
         # prev_participants is [] before the first quorum: joining is not
@@ -856,7 +905,8 @@ class Manager:
         # snapshot this epoch's rank→replica map: an in-flight op can fail
         # AFTER the next quorum has renumbered ranks, and a PeerGoneError
         # mapped through the new list would accuse an innocent replica
-        ids_snapshot = list(self._participant_ids)
+        with self._evict_lock:
+            ids_snapshot = list(self._participant_ids)
 
         try:
             work = self._collectives.allreduce(tensors, ReduceOp.SUM)
@@ -948,9 +998,10 @@ class Manager:
             # long-poll parks the trainer's wait_quorum on a quorum that
             # cannot form until the victim respawns — strictly worse than
             # the old fail-fast-then-retry path.
-            alive = len(
-                [p for p in self._participant_ids if p not in self._evicted]
-            )
+            with self._evict_lock:
+                alive = len(
+                    [p for p in self._participant_ids if p not in self._evicted]
+                )
             if alive < max(1, self._min_replica_size):
                 return
             _, shrink_only, timeout = self._last_quorum_args
@@ -988,14 +1039,18 @@ class Manager:
                 break
             cause = cause.__cause__ or cause.__context__
             seen += 1
-        if participants is None:
-            participants = list(self._participant_ids)
-        if peer is None or not (0 <= peer < len(participants)):
-            return
-        victim = participants[peer]
-        if victim in self._evicted:
-            return
-        self._evicted.add(victim)
+        with self._evict_lock:
+            if participants is None:
+                participants = list(self._participant_ids)
+            if peer is None or not (0 <= peer < len(participants)):
+                return
+            victim = participants[peer]
+            if victim in self._evicted:
+                # already reported this epoch — the check-and-add must be
+                # one atomic step: report_error (main/op-callback threads)
+                # and the death watch race into here for the same victim
+                return
+            self._evicted.add(victim)
         # the trail's detection record lives HERE, not in the death-watch
         # callback: a dead peer can also surface as a PeerGoneError from a
         # failed collective/p2p op (report_error path) without the poll

@@ -185,7 +185,7 @@ class CollectivesProxy(Collectives):
         self._world = 0
         self._op_id = 0
         self._generation = 0
-        self._pending: Dict[int, Future] = {}
+        self._pending: Dict[int, Future] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._drain: Optional[threading.Thread] = None
         self._inner_plane = ""  # child backend's live plane label
@@ -217,7 +217,8 @@ class CollectivesProxy(Collectives):
         # drain thread closes over its own generation's proc/rx so a stale
         # thread from a previous child can never touch the new pending map
         self._drain = threading.Thread(
-            target=self._drain_loop, args=(proc, rx, gen), daemon=True
+            target=self._drain_loop, args=(proc, rx, gen), daemon=True,
+            name="tft_proxy_drain",
         )
         self._drain.start()
         # cache the child's live plane label once per epoch: configure is

@@ -275,7 +275,7 @@ class StepWatchdog:
         self._fired_step: Optional[int] = None
         self._thread: Optional[threading.Thread] = None
         self._running = True
-        self.stalled = False
+        self.stalled = False  # guarded-by: _cond
         self.stalls = 0
 
     @property
