@@ -208,7 +208,9 @@ _GATE_TOLERANCE_PCT = 15.0  # past run-to-run spread on this 1-core box
 # resnet18_cifar: ~10-15 ms steps against ~5 tunnel RPCs each — the row
 # is dispatch-latency-bound and its isolated per-invocation median spans
 # 44-96 steps/s on this box (resnet_ft.py round-5 addendum)
-_GATE_WIDE_ROWS = {"crossgroup_host_plane", "resnet18_cifar"}
+_GATE_WIDE_ROWS = {
+    "crossgroup_host_plane", "resnet18_cifar", "crossgroup_compressed",
+}
 _GATE_WIDE_TOLERANCE_PCT = 40.0
 
 
@@ -284,9 +286,34 @@ def _apply_regression_gate(extra: dict, headline_sps: float) -> None:
             )
         return True
 
+    def base_has_gated_metric(base_row: dict) -> bool:
+        for field in _GATE_FIELDS:
+            if isinstance(base_row.get(field), (int, float)):
+                return True
+        return any(
+            isinstance(sub, dict) and base_has_gated_metric(sub)
+            for sub in base_row.values()
+        )
+
     for name, row in extra.items():
         base_row = baseline.get(name)
         if isinstance(row, dict) and isinstance(base_row, dict):
+            row_has_data = any(
+                isinstance(v, dict) or k in _GATE_FIELDS
+                for k, v in row.items()
+            )
+            if "error" in row and not row_has_data and base_has_gated_metric(
+                base_row
+            ):
+                # a whole-row failure must not silently bypass the gate:
+                # the baseline measured this row, so losing it entirely is
+                # the loudest regression there is (gate_row's per-field
+                # MISSING check only fires when the sub-dicts survive)
+                regressions.append(
+                    f"{name}: previously-measured row errored "
+                    f"({str(row['error'])[:200]})"
+                )
+                continue
             if name == "resnet18_cifar" and gate_resnet_on_max(row, base_row):
                 continue
             tol = (
@@ -546,6 +573,26 @@ def main() -> None:
         )
     except Exception as e:  # noqa: BLE001
         extra["crossgroup_host_plane"] = {"error": str(e)}
+
+    # int8-compressed wire over the forced tcp-striped plane (serial +
+    # streamed) — the wire-speed tentpole row, gated on gb_per_sec so a
+    # codec/overlap regression fails loudly (docs/wire_plane.md)
+    try:
+        extra["crossgroup_compressed"] = _run_json_subprocess(
+            [
+                sys.executable,
+                "-m",
+                "torchft_tpu.benchmarks.crossgroup",
+                "--compressed",
+                "--total-mb",
+                "128",
+                "--rounds",
+                "2",
+            ],
+            timeout_s=900,
+        )
+    except Exception as e:  # noqa: BLE001
+        extra["crossgroup_compressed"] = {"error": str(e)}
 
     # recovery envelope (BASELINE.md driver metric): SIGKILL 1 of N replica
     # groups on CPU, measure blackout + rejoin. N=4 is the BASELINE

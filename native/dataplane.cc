@@ -77,6 +77,69 @@ void encode_bf16(const float* src, uint16_t* dst, size_t n) {
   for (size_t i = 0; i < n; ++i) dst[i] = f32_to_bf16(src[i]);
 }
 
+// int8 wire format (must match wire_codec.Int8Codec byte for byte): a
+// 4-byte LE f32 scale header (max|x|/127; NaN when the chunk holds any
+// non-finite value, so NaN propagates loudly through the decode instead
+// of being laundered into a finite average) followed by one int8 per
+// element, round-to-nearest-even like np.rint.
+size_t wire_nbytes(DpCodec codec, size_t nelems) {
+  switch (codec) {
+    case DpCodec::kBf16:
+      return nelems * 2;
+    case DpCodec::kInt8:
+      return 4 + nelems;
+    case DpCodec::kF32:
+    default:
+      return nelems * 4;
+  }
+}
+
+// round-half-even without a libm call: adding/subtracting 1.5*2^23
+// rounds any |v| < 2^22 to the nearest even integer in the default FP
+// mode, and the expression vectorizes to two adds (baseline x86-64 has
+// no roundss, so nearbyintf would be a per-element function call — it
+// measured as the whole int8 row's bottleneck on a 2-core box). Inputs
+// here satisfy |v| <= 127(1+eps) by construction (scale = amax/127).
+inline float round_half_even_small(float v) {
+  const float magic = 12582912.0f;  // 1.5 * 2^23
+  return (v + magic) - magic;
+}
+
+void encode_int8(const float* src, uint8_t* dst, size_t n) {
+  float amax = 0.0f;
+  bool finite = true;
+  for (size_t i = 0; i < n; ++i) {
+    float a = std::fabs(src[i]);
+    if (!std::isfinite(a)) finite = false;
+    if (a > amax) amax = a;
+  }
+  float scale;
+  if (!finite) {
+    scale = std::numeric_limits<float>::quiet_NaN();
+  } else {
+    scale = amax > 0.0f ? amax / 127.0f : 0.0f;
+  }
+  std::memcpy(dst, &scale, 4);
+  int8_t* q = (int8_t*)(dst + 4);
+  if (!finite || scale == 0.0f) {
+    std::memset(q, 0, n);
+    return;
+  }
+  for (size_t i = 0; i < n; ++i) {
+    float v = round_half_even_small(src[i] / scale);
+    if (v > 127.0f) v = 127.0f;
+    if (v < -127.0f) v = -127.0f;
+    q[i] = (int8_t)v;
+  }
+}
+
+void decode_int8(const uint8_t* wire, float* dst, size_t n) {
+  float scale;
+  std::memcpy(&scale, wire, 4);
+  const int8_t* q = (const int8_t*)(wire + 4);
+  for (size_t i = 0; i < n; ++i) dst[i] = (float)q[i] * scale;
+}
+
 // NaN-propagating max/min, matching np.maximum/np.minimum (the Python
 // ring's semantics): a NaN in either operand wins — allreduce-MAX is used
 // as a grad-norm overflow tripwire and must not launder NaN away.
@@ -115,6 +178,24 @@ void reduce_from_bf16(float* acc, const uint16_t* in, size_t n, DpOp op) {
       break;
     case DpOp::kMin:
       for (size_t i = 0; i < n; ++i) acc[i] = nan_min(acc[i], bf16_to_f32(in[i]));
+      break;
+  }
+}
+
+void reduce_from_int8(float* acc, const uint8_t* wire, size_t n, DpOp op) {
+  float scale;
+  std::memcpy(&scale, wire, 4);
+  const int8_t* q = (const int8_t*)(wire + 4);
+  switch (op) {
+    case DpOp::kSum:
+    case DpOp::kAvg:
+      for (size_t i = 0; i < n; ++i) acc[i] += (float)q[i] * scale;
+      break;
+    case DpOp::kMax:
+      for (size_t i = 0; i < n; ++i) acc[i] = nan_max(acc[i], (float)q[i] * scale);
+      break;
+    case DpOp::kMin:
+      for (size_t i = 0; i < n; ++i) acc[i] = nan_min(acc[i], (float)q[i] * scale);
       break;
   }
 }
@@ -484,24 +565,35 @@ bool DataPlane::hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
       return false;
     }
     if (send_i >= 0 && (pfd[send_i].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      // header first, then payload
-      while (sh_off < sizeof(shdr)) {
-        ssize_t k = ::send(send_fd, (const uint8_t*)&shdr + sh_off,
-                           sizeof(shdr) - sh_off, MSG_NOSIGNAL);
-        if (k > 0) {
-          sh_off += (size_t)k;
-        } else if (k < 0 && err_wouldblock(errno)) {
-          break;
-        } else {
-          *send_failed = true;
-          *err = std::string("send: ") + (k == 0 ? "closed" : errno_str(errno));
-          return false;
+      // scatter-gather: header + payload leave in ONE sendmsg from their
+      // own buffers — no coalescing copy, and the common case is a
+      // single syscall per pump instead of two
+      while (sh_off < sizeof(shdr) || s_off < sn) {
+        iovec iov[2];
+        int cnt = 0;
+        if (sh_off < sizeof(shdr)) {
+          iov[cnt].iov_base = (uint8_t*)&shdr + sh_off;
+          iov[cnt].iov_len = sizeof(shdr) - sh_off;
+          ++cnt;
         }
-      }
-      while (sh_off == sizeof(shdr) && s_off < sn) {
-        ssize_t k = ::send(send_fd, sbuf + s_off, sn - s_off, MSG_NOSIGNAL);
+        if (s_off < sn) {
+          iov[cnt].iov_base = (void*)(sbuf + s_off);
+          iov[cnt].iov_len = sn - s_off;
+          ++cnt;
+        }
+        msghdr mh{};
+        mh.msg_iov = iov;
+        mh.msg_iovlen = cnt;
+        ssize_t k = ::sendmsg(send_fd, &mh, MSG_NOSIGNAL);
         if (k > 0) {
-          s_off += (size_t)k;
+          size_t adv = (size_t)k;
+          if (sh_off < sizeof(shdr)) {
+            size_t h = sizeof(shdr) - sh_off;
+            size_t hh = adv < h ? adv : h;
+            sh_off += hh;
+            adv -= hh;
+          }
+          s_off += adv;
         } else if (k < 0 && err_wouldblock(errno)) {
           break;
         } else {
@@ -655,7 +747,8 @@ int DataPlane::run_stripe(int stripe_idx, Job& job, int* bad_peer,
   // one acquire-load per job: pairs with enable_cma's release-store so
   // peer_pids_ is fully visible before the first CMA hop of this job
   const bool use_cma = cma_.load(std::memory_order_acquire);
-  if (use_cma) job.wire_bf16 = false;
+  if (use_cma) job.codec = DpCodec::kF32;
+  const DpCodec codec = job.codec;
 
   float* flat = (float*)job.base;
   int64_t n = job.nelems;
@@ -668,18 +761,26 @@ int DataPlane::run_stripe(int stripe_idx, Job& job, int* bad_peer,
   for (int i = 0; i < world_; ++i) {
     if (chunk_n(i) > max_chunk) max_chunk = chunk_n(i);
   }
-  const size_t wire_elt = job.wire_bf16 ? 2 : 4;
+  const size_t max_wire = wire_nbytes(codec, max_chunk);
   auto& st = *stripes_[stripe_idx];
-  st.scratch_send.resize(max_chunk * wire_elt);
-  st.scratch_recv.resize(max_chunk * wire_elt);
+  st.scratch_send.resize(max_wire);
+  st.scratch_recv.resize(max_wire);
+  if (codec != DpCodec::kF32) st.scratch_fwd.resize(max_wire);
 
   auto prep_send = [&](int idx) -> std::pair<const uint8_t*, size_t> {
     size_t cn = chunk_n(idx);
-    if (job.wire_bf16) {
-      encode_bf16(chunk_ptr(idx), (uint16_t*)st.scratch_send.data(), cn);
-      return {st.scratch_send.data(), cn * 2};
+    switch (codec) {
+      case DpCodec::kBf16:
+        encode_bf16(chunk_ptr(idx), (uint16_t*)st.scratch_send.data(), cn);
+        return {st.scratch_send.data(), cn * 2};
+      case DpCodec::kInt8:
+        encode_int8(chunk_ptr(idx), st.scratch_send.data(), cn);
+        return {st.scratch_send.data(), 4 + cn};
+      case DpCodec::kF32:
+      default:
+        // zero-copy: the chunk's own bytes are the wire form
+        return {(const uint8_t*)chunk_ptr(idx), cn * 4};
     }
-    return {(const uint8_t*)chunk_ptr(idx), cn * 4};
   };
 
   bool send_failed = false;
@@ -705,49 +806,92 @@ int DataPlane::run_stripe(int stripe_idx, Job& job, int* bad_peer,
     *bad_peer = send_failed ? right : left;
     return -1;
   };
-  // reduce-scatter phase
+  // reduce-scatter phase: every hop ships a freshly encoded partial sum
+  // (re-quantized at its own magnitude); accumulation stays f32
   for (int step = 0; step < world_ - 1; ++step) {
     int send_idx = ((rank_ - step) % world_ + world_) % world_;
     int recv_idx = ((rank_ - step - 1) % world_ + world_) % world_;
     auto [sb, sn] = prep_send(send_idx);
-    size_t rn = chunk_n(recv_idx) * wire_elt;
+    size_t rn = wire_nbytes(codec, chunk_n(recv_idx));
     if (!do_hop(sb, sn, st.scratch_recv.data(), rn)) {
       return fail();
     }
-    if (job.wire_bf16) {
-      reduce_from_bf16(chunk_ptr(recv_idx),
-                       (const uint16_t*)st.scratch_recv.data(),
-                       chunk_n(recv_idx), job.op);
-    } else {
-      reduce_f32(chunk_ptr(recv_idx), (const float*)st.scratch_recv.data(),
-                 chunk_n(recv_idx), job.op);
+    switch (codec) {
+      case DpCodec::kBf16:
+        reduce_from_bf16(chunk_ptr(recv_idx),
+                         (const uint16_t*)st.scratch_recv.data(),
+                         chunk_n(recv_idx), job.op);
+        break;
+      case DpCodec::kInt8:
+        reduce_from_int8(chunk_ptr(recv_idx), st.scratch_recv.data(),
+                         chunk_n(recv_idx), job.op);
+        break;
+      case DpCodec::kF32:
+      default:
+        reduce_f32(chunk_ptr(recv_idx), (const float*)st.scratch_recv.data(),
+                   chunk_n(recv_idx), job.op);
+        break;
     }
   }
-  // deterministic lossy wire: the owner of the fully reduced chunk must
-  // hold the same bf16-rounded value every other rank receives
-  // (collectives.py has the same round-trip — advisor round-3 high)
-  if (job.wire_bf16 && world_ > 1) {
+  if (codec == DpCodec::kF32) {
+    // raw allgather: f32 lands straight in the target chunk and the
+    // forwarded bytes are the owner's bytes by nature
+    for (int step = 0; step < world_ - 1; ++step) {
+      int send_idx = ((rank_ + 1 - step) % world_ + world_) % world_;
+      int recv_idx = ((rank_ - step) % world_ + world_) % world_;
+      auto [sb, sn] = prep_send(send_idx);
+      float* dst = chunk_ptr(recv_idx);
+      size_t cn = chunk_n(recv_idx);
+      if (!do_hop(sb, sn, (uint8_t*)dst, cn * 4)) {
+        return fail();
+      }
+    }
+  } else if (world_ > 1) {
+    // lossy allgather: the owner of each fully reduced chunk encodes it
+    // ONCE; its wire bytes then circulate VERBATIM (intermediate ranks
+    // forward what they received, zero re-encode work) and the owner
+    // keeps the decode of its own bytes — every rank lands on the
+    // identical f32 image by construction, not by fp-rounding luck
+    // (collectives.py's _ring_allreduce_codec is the same schedule)
     int owned = (rank_ + 1) % world_;
-    float* c = chunk_ptr(owned);
-    for (size_t i = 0; i < chunk_n(owned); ++i) {
-      c[i] = bf16_to_f32(f32_to_bf16(c[i]));
+    size_t own_wire = wire_nbytes(codec, chunk_n(owned));
+    switch (codec) {
+      case DpCodec::kBf16:
+        encode_bf16(chunk_ptr(owned), (uint16_t*)st.scratch_fwd.data(),
+                    chunk_n(owned));
+        for (size_t i = 0; i < chunk_n(owned); ++i) {
+          chunk_ptr(owned)[i] =
+              bf16_to_f32(((const uint16_t*)st.scratch_fwd.data())[i]);
+        }
+        break;
+      case DpCodec::kInt8:
+        encode_int8(chunk_ptr(owned), st.scratch_fwd.data(), chunk_n(owned));
+        decode_int8(st.scratch_fwd.data(), chunk_ptr(owned), chunk_n(owned));
+        break;
+      default:
+        break;
     }
-  }
-  // allgather phase (raw f32 lands straight in the target chunk; only the
-  // bf16 wire needs the decode bounce through scratch)
-  for (int step = 0; step < world_ - 1; ++step) {
-    int send_idx = ((rank_ + 1 - step) % world_ + world_) % world_;
-    int recv_idx = ((rank_ - step) % world_ + world_) % world_;
-    auto [sb, sn] = prep_send(send_idx);
-    float* dst = chunk_ptr(recv_idx);
-    size_t cn = chunk_n(recv_idx);
-    uint8_t* rb = job.wire_bf16 ? st.scratch_recv.data() : (uint8_t*)dst;
-    if (!do_hop(sb, sn, rb, cn * wire_elt)) {
-      return fail();
-    }
-    if (job.wire_bf16) {
-      const uint16_t* in = (const uint16_t*)st.scratch_recv.data();
-      for (size_t i = 0; i < cn; ++i) dst[i] = bf16_to_f32(in[i]);
+    uint8_t* cur = st.scratch_fwd.data();
+    size_t cur_n = own_wire;
+    uint8_t* spare = st.scratch_recv.data();
+    for (int step = 0; step < world_ - 1; ++step) {
+      int recv_idx = ((rank_ - step) % world_ + world_) % world_;
+      size_t cn = chunk_n(recv_idx);
+      size_t rn = wire_nbytes(codec, cn);
+      if (!do_hop(cur, cur_n, spare, rn)) {
+        return fail();
+      }
+      if (codec == DpCodec::kBf16) {
+        const uint16_t* in = (const uint16_t*)spare;
+        float* dst = chunk_ptr(recv_idx);
+        for (size_t i = 0; i < cn; ++i) dst[i] = bf16_to_f32(in[i]);
+      } else {
+        decode_int8(spare, chunk_ptr(recv_idx), cn);
+      }
+      uint8_t* t = cur;
+      cur = spare;
+      spare = t;
+      cur_n = rn;
     }
   }
   if (job.op == DpOp::kAvg) {
@@ -783,11 +927,16 @@ void DataPlane::worker_loop(int stripe_idx) {
 }
 
 int DataPlane::allreduce(void* data, int64_t nelems, DpDtype dtype, DpOp op,
-                         bool wire_bf16, uint32_t tag, int64_t timeout_ms,
+                         DpCodec codec, uint32_t tag, int64_t timeout_ms,
                          int* bad_peer, std::string* err) {
   *bad_peer = -1;
   if (dtype != DpDtype::kF32) {
     *err = "unsupported dtype";
+    return -1;
+  }
+  if (codec != DpCodec::kF32 && codec != DpCodec::kBf16 &&
+      codec != DpCodec::kInt8) {
+    *err = "unsupported wire codec";
     return -1;
   }
   if (world_ <= 1 || nelems == 0) return 0;
@@ -807,7 +956,7 @@ int DataPlane::allreduce(void* data, int64_t nelems, DpDtype dtype, DpOp op,
     st.job.base = (uint8_t*)((float*)data + sb[s]);
     st.job.nelems = sb[s + 1] - sb[s];
     st.job.op = op;
-    st.job.wire_bf16 = wire_bf16;
+    st.job.codec = codec;
     st.job.tag = tag + (uint32_t)s;
     st.job.deadline_ms = deadline;
     st.has_job = true;
@@ -874,6 +1023,13 @@ void dp_set_err(char* err, int errlen, const std::string& msg) {
 
 extern "C" {
 
+// Bumped whenever the ctypes-visible surface changes SHAPE or MEANING
+// (v2: tft_dp_allreduce's `wire_bf16` int became the DpCodec enum — a
+// stale library would silently reinterpret codec=2 as wire_bf16=true).
+// The Python loader (_native/__init__.py) refuses to run a mismatched
+// build and rebuilds in place.
+int tft_abi_version() { return 2; }
+
 int64_t tft_dp_create(int rank, int world, int nstripes, char* err,
                       int errlen) {
   try {
@@ -934,7 +1090,7 @@ int tft_dp_enable_cma(int64_t h, const int64_t* pids, int n, char* err,
 }
 
 int tft_dp_allreduce(int64_t h, void* data, int64_t nelems, int dtype, int op,
-                     int wire_bf16, uint32_t tag, int64_t timeout_ms,
+                     int codec, uint32_t tag, int64_t timeout_ms,
                      int* bad_peer, char* err, int errlen) {
   auto dp = dp_get(h);
   if (!dp) {
@@ -944,7 +1100,7 @@ int tft_dp_allreduce(int64_t h, void* data, int64_t nelems, int dtype, int op,
   std::string e;
   int bp = -1;
   int rc = dp->allreduce(data, nelems, (tft::DpDtype)dtype, (tft::DpOp)op,
-                         wire_bf16 != 0, tag, timeout_ms, &bp, &e);
+                         (tft::DpCodec)codec, tag, timeout_ms, &bp, &e);
   if (bad_peer) *bad_peer = bp;
   if (rc != 0) dp_set_err(err, errlen, e);
   return rc;

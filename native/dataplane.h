@@ -30,6 +30,14 @@ namespace tft {
 enum class DpDtype : int { kF32 = 0 };
 // reduce ops (AVG divides after the allgather phase)
 enum class DpOp : int { kSum = 0, kAvg = 1, kMax = 2, kMin = 3 };
+// wire codecs (torchft_tpu/wire_codec.py mirrors these formats byte for
+// byte; values must match the ctypes binding's NativeDataPlane.CODEC):
+//   kF32  — raw 4 bytes/elem
+//   kBf16 — round-to-nearest-even truncation, 2 bytes/elem
+//   kInt8 — per-chunk symmetric quantization: a 4-byte LE f32 scale
+//           header (max|x|/127; NaN when the chunk holds non-finite
+//           values so NaN propagates loudly) + one int8 per element
+enum class DpCodec : int { kF32 = 0, kBf16 = 1, kInt8 = 2 };
 
 class DataPlane {
  public:
@@ -66,9 +74,12 @@ class DataPlane {
   // ring rank whose socket failed (or -1 if indeterminate), or -2 on
   // DEADLINE with *bad_peer = -1 — a slow-but-alive peer must surface as
   // a retryable timeout, never as an eviction-worthy accusation (the
-  // Python mesh draws the same line).
+  // Python mesh draws the same line). With a lossy codec the wire
+  // carries encoded bytes while accumulation stays f32; the allgather
+  // phase forwards the chunk owner's wire bytes VERBATIM, so the decoded
+  // average is bit-identical on every rank by construction.
   int allreduce(void* data, int64_t nelems, DpDtype dtype, DpOp op,
-                bool wire_bf16, uint32_t tag, int64_t timeout_ms,
+                DpCodec codec, uint32_t tag, int64_t timeout_ms,
                 int* bad_peer, std::string* err);
 
   void shutdown();
@@ -78,7 +89,7 @@ class DataPlane {
     uint8_t* base = nullptr;   // stripe start
     int64_t nelems = 0;        // stripe elements
     DpOp op = DpOp::kSum;
-    bool wire_bf16 = false;
+    DpCodec codec = DpCodec::kF32;
     uint32_t tag = 0;
     int64_t deadline_ms = 0;  // absolute, now_ms() clock
   };
@@ -92,8 +103,11 @@ class DataPlane {
     int rc = 0;
     int bad_peer = -1;
     std::string err;
+    // per-epoch wire scratch (vectors keep their capacity across jobs,
+    // so the hot path never allocates after the first round)
     std::vector<uint8_t> scratch_send;  // wire-encoded outgoing chunk
     std::vector<uint8_t> scratch_recv;  // wire-encoded incoming chunk
+    std::vector<uint8_t> scratch_fwd;   // verbatim-forward double buffer
   };
 
   void accept_loop();

@@ -126,6 +126,32 @@ class TestWireDriftFixtures:
         )
         assert got == {"A": 3, "B": 4, "C": 9, "D": 10}
 
+    def test_wire_env_drift_both_directions(self):
+        # code reads CODEC (documented) and GHOST (undocumented); the doc
+        # additionally promises STALE, which nothing reads
+        py = {
+            "a.py": 'os.environ.get("TORCHFT_WIRE_CODEC")\n'
+                    'os.environ.get("TORCHFT_WIRE_GHOST")\n',
+        }
+        doc = (
+            "| knob | default |\n"
+            "| `TORCHFT_WIRE_CODEC` | f32 |\n"
+            "| `TORCHFT_WIRE_STALE` | 1 |\n"
+        )
+        finds = wiredrift.check_wire_env(py, doc)
+        msgs = {f.symbol: f.message for f in finds}
+        assert "TORCHFT_WIRE_GHOST" in msgs
+        assert "missing from" in msgs["TORCHFT_WIRE_GHOST"]
+        assert "TORCHFT_WIRE_STALE" in msgs
+        assert "no code reads" in msgs["TORCHFT_WIRE_STALE"]
+        assert "TORCHFT_WIRE_CODEC" not in msgs
+
+    def test_wire_env_clean_tree(self):
+        # the live repo's TORCHFT_WIRE_* knob family must match the
+        # docs/wire_plane.md registry exactly (the PR 6 satellite)
+        finds = [f for f in wiredrift.run() if f.rule == "wire-env-drift"]
+        assert finds == []
+
 
 # ---------------------------------------------------------------------------
 # doc-drift fixtures

@@ -37,7 +37,14 @@ UNAVAILABLE = 6
 _TIMEOUT_CODES = (CANCELLED, DEADLINE_EXCEEDED)
 
 
-def _build() -> None:
+# The C ABI contract between this loader and libtftcore.so; must match
+# native `tft_abi_version()`. v2: tft_dp_allreduce's wire_bf16 int became
+# the DpCodec enum — calling an old build with codec=2 would silently run
+# the bf16 wire, so a mismatch forces a rebuild instead of proceeding.
+_ABI_VERSION = 2
+
+
+def _build(force: bool = False) -> None:
     # Serialize concurrent first-import builds across worker processes
     # (multi-rank launches all hit this path on a fresh checkout).
     import fcntl
@@ -46,15 +53,53 @@ def _build() -> None:
     with open(lock_path, "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
         try:
-            if not os.path.exists(_LIB_PATH):
+            if force and os.path.exists(_LIB_PATH):
+                # another rank may have rebuilt while we waited on the
+                # lock: re-check the on-disk ABI (via a temp copy — a
+                # direct dlopen would pin the path in this namespace)
+                # before paying a redundant full rebuild
+                if _abi_of_file(_LIB_PATH) == _ABI_VERSION:
+                    return
+            if force or not os.path.exists(_LIB_PATH):
                 subprocess.run(
-                    ["make", "-s"],
+                    ["make", "-s", "-B"] if force else ["make", "-s"],
                     cwd=_NATIVE_SRC,
                     check=True,
                     capture_output=True,
                 )
         finally:
             fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def _abi_of(lib: ctypes.CDLL) -> int:
+    try:
+        fn = lib.tft_abi_version
+    except AttributeError:
+        return 1  # pre-versioning build
+    fn.restype = ctypes.c_int
+    fn.argtypes = []
+    return int(fn())
+
+
+def _abi_of_file(path: str) -> int:
+    """ABI of an on-disk library, probed through a unique temp copy so
+    the real path never enters this process's dlopen namespace (a cached
+    mapping there would mask later rebuilds)."""
+    import shutil
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    try:
+        shutil.copy2(path, tmp)
+        return _abi_of(ctypes.CDLL(tmp))
+    except OSError:
+        return 0  # unreadable/unloadable: treat as stale
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def _load() -> ctypes.CDLL:
@@ -66,6 +111,39 @@ def _load() -> ctypes.CDLL:
             )
         _build()
     lib = ctypes.CDLL(_LIB_PATH)
+    if _abi_of(lib) != _ABI_VERSION:
+        if _LIB_OVERRIDE:
+            raise RuntimeError(
+                f"TORCHFT_NATIVE_LIB={_LIB_OVERRIDE} reports ABI "
+                f"{_abi_of(lib)}, this loader needs {_ABI_VERSION}; "
+                "rebuild it (e.g. `make -C native asan`)"
+            )
+        # Stale build from an older checkout: rebuild in place, then load
+        # the fresh object through a unique temp path — re-dlopen of the
+        # SAME path can return the old mapping (the C++ runtime marks the
+        # object NODELETE, so dlclose never unloads it). The temp file is
+        # unlinked immediately after dlopen; the mapping stays valid.
+        import shutil
+        import tempfile
+
+        _build(force=True)
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+        os.close(fd)
+        try:
+            shutil.copy2(_LIB_PATH, tmp)
+            lib = ctypes.CDLL(tmp)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        got = _abi_of(lib)
+        if got != _ABI_VERSION:
+            raise RuntimeError(
+                f"native ABI mismatch persists after rebuild: library "
+                f"reports {got}, loader needs {_ABI_VERSION} — stale "
+                f"{_LIB_PATH}? remove it and re-import"
+            )
 
     c = ctypes
     u8p = c.POINTER(c.c_uint8)
@@ -390,6 +468,9 @@ class NativeDataPlane:
 
     DTYPE_F32 = 0
     OP = {"sum": 0, "avg": 1, "max": 2, "min": 3}
+    # wire codecs (native/dataplane.h DpCodec; formats mirror
+    # torchft_tpu/wire_codec.py byte for byte)
+    CODEC = {"f32": 0, "bfloat16": 1, "int8": 2}
 
     def __init__(self, rank: int, world: int, nstripes: int = 4) -> None:
         err = _errbuf()
@@ -432,17 +513,21 @@ class NativeDataPlane:
         ptr: int,
         nelems: int,
         op: str,
-        wire_bf16: bool,
-        tag: int,
-        timeout_ms: int,
+        codec: "int | str" = 0,
+        tag: int = 0,
+        timeout_ms: int = 60000,
     ) -> None:
         """In-place f32 ring allreduce on the buffer at ``ptr``. Blocking —
-        call from the collectives op thread; the GIL is released."""
+        call from the collectives op thread; the GIL is released.
+        ``codec`` selects the wire format (``CODEC`` map / DpCodec enum):
+        lossy codecs quantize on the wire while accumulation stays f32,
+        and the decoded result is bit-identical on every rank."""
         err = _errbuf()
         bad_peer = ctypes.c_int(-1)
+        codec_i = self.CODEC[codec] if isinstance(codec, str) else int(codec)
         rc = _lib.tft_dp_allreduce(
             self._h, ptr, nelems, self.DTYPE_F32, self.OP[op],
-            1 if wire_bf16 else 0, tag, timeout_ms,
+            codec_i, tag, timeout_ms,
             ctypes.byref(bad_peer), err, _ERRLEN,
         )
         if rc == -2:

@@ -60,6 +60,7 @@ class DataPlaneError(ConnectionError):
 class NativeDataPlane:
     DTYPE_F32: int
     OP: Dict[str, int]
+    CODEC: Dict[str, int]
     rank: int
     world: int
     nstripes: int
@@ -75,8 +76,8 @@ class NativeDataPlane:
         ptr: int,
         nelems: int,
         op: str,
-        wire_bf16: bool,
-        tag: int,
-        timeout_ms: int,
+        codec: int | str = ...,
+        tag: int = ...,
+        timeout_ms: int = ...,
     ) -> None: ...
     def close(self) -> None: ...

@@ -30,6 +30,13 @@ Checks (rule ids):
     knobs referenced from Python (must be a subset of the parsed set —
     a scenario driving an unparsed knob silently no-ops).
 
+``wire-env-drift``
+    The ``TORCHFT_WIRE_*`` family (the wire-plane knob registry): knobs
+    referenced anywhere in the Python tree vs the knob table in
+    ``docs/wire_plane.md``, both directions — an undocumented knob is
+    invisible to operators, a documented-but-unparsed knob silently
+    no-ops in deploy configs.
+
 ``fault-site-drift``
     Native evidence-record site labels (``fi::write_evidence`` /
     ``fi::kill_self`` call sites) vs ``faultinject.core.NATIVE_SITES``:
@@ -233,6 +240,32 @@ def check_fi_env(
     return finds
 
 
+_WIRE_RE = re.compile(r"TORCHFT_WIRE_[A-Z0-9_]+")
+
+
+def check_wire_env(
+    py_texts: Dict[str, str], wire_doc_text: str
+) -> List[Finding]:
+    py: Set[str] = set()
+    for text in py_texts.values():
+        py.update(_WIRE_RE.findall(text))
+    doc = set(_WIRE_RE.findall(wire_doc_text))
+    finds: List[Finding] = []
+    for k in sorted(py - doc):
+        finds.append(Finding(
+            "wire-env-drift", "docs/wire_plane.md", 0, k,
+            "wire-plane knob referenced in code but missing from the "
+            "docs/wire_plane.md knob registry — invisible to operators",
+        ))
+    for k in sorted(doc - py):
+        finds.append(Finding(
+            "wire-env-drift", "docs/wire_plane.md", 0, k,
+            "documented wire-plane knob that no code reads — a deploy "
+            "config setting it silently no-ops",
+        ))
+    return finds
+
+
 def check_fault_sites(
     native_texts: Dict[str, str], native_sites: tuple
 ) -> List[Finding]:
@@ -301,6 +334,12 @@ def run(root: Optional[str] = None) -> List[Finding]:
     native_init = _read(root, "torchft_tpu/_native/__init__.py")
     pyi = _read(root, "torchft_tpu/_native/__init__.pyi")
     doc = _read(root, "docs/fault_injection.md")
+    wire_doc_path = os.path.join(root, "docs", "wire_plane.md")
+    wire_doc = (
+        _read(root, "docs/wire_plane.md")
+        if os.path.exists(wire_doc_path)
+        else ""
+    )
 
     py_rpc = {rel: _read(root, rel) for rel in _PY_RPC_SOURCES}
     py_fi: Dict[str, str] = {}
@@ -319,6 +358,7 @@ def run(root: Optional[str] = None) -> List[Finding]:
     out += check_status_codes(wire_h, native_init, pyi)
     out += check_rpc_methods(native_texts, py_rpc)
     out += check_fi_env(native_texts, doc, py_fi)
+    out += check_wire_env(py_fi, wire_doc)
     out += check_fault_sites(native_texts, NATIVE_SITES)
     out += check_stub(native_init, pyi)
     return out

@@ -219,12 +219,13 @@ def _worker_main(argv: List[str]) -> None:
     pin_platform_from_env()
 
     store = StoreServer()
+    coll = CollectivesTcp(
+        timeout=timedelta(seconds=120),
+        hostname="localhost",
+        wire_dtype=args.wire_dtype or None,
+    )
     manager = Manager(
-        collectives=CollectivesTcp(
-            timeout=timedelta(seconds=120),
-            hostname="localhost",
-            wire_dtype=args.wire_dtype or None,
-        ),
+        collectives=coll,
         load_state_dict=lambda s: None,
         state_dict=lambda: {},
         min_replica_size=2,
@@ -270,12 +271,23 @@ def _worker_main(argv: List[str]) -> None:
         run()
         assert manager.should_commit(), "warmup step failed to commit"
 
+        # per-stage attribution (host-copy / quantize / wire /
+        # dequantize-reduce, docs/wire_plane.md): reset AFTER warmup so
+        # the breakdown covers exactly the timed rounds — this is what
+        # explains a wire-row delta instead of leaving it a mystery
+        from torchft_tpu.collectives import wire_stage_snapshot
+
+        wire_stage_snapshot(reset=True)
         t0 = time.perf_counter()
         for _ in range(args.rounds):
             manager.start_quorum()
             run()
             assert manager.should_commit(), "bench step failed to commit"
         elapsed = (time.perf_counter() - t0) / args.rounds
+        stages = {
+            k: round(v / args.rounds, 4)
+            for k, v in wire_stage_snapshot().items()
+        }
 
         print(
             json.dumps(
@@ -283,6 +295,9 @@ def _worker_main(argv: List[str]) -> None:
                     "gid": args.gid,
                     "seconds_per_round": elapsed,
                     "total_bytes": total_bytes,
+                    "plane": coll.plane_info(),
+                    "wire_codec": coll.wire_codec(),
+                    "stages_per_round_s": stages,
                 }
             ),
             flush=True,
@@ -298,10 +313,13 @@ def _run_pair(
     rounds: int,
     wire_dtype: str,
     serial: bool,
-) -> Dict[str, float]:
+    env_extra: Optional[Dict[str, str]] = None,
+) -> Dict[str, object]:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
+    if env_extra:
+        env.update(env_extra)
     procs = []
     for gid in range(2):
         cmd = [
@@ -336,12 +354,18 @@ def _run_pair(
                 f"{err.decode()[-2000:]}"
             )
         results.append(json.loads(out.decode().strip().splitlines()[-1]))
-    secs = max(r["seconds_per_round"] for r in results)
+    slow = max(results, key=lambda r: r["seconds_per_round"])
+    secs = slow["seconds_per_round"]
     total_bytes = results[0]["total_bytes"]
     return {
         "seconds_per_round": secs,
         "gb_per_sec": total_bytes / secs / 1e9,
         "total_bytes": total_bytes,
+        "plane": slow.get("plane", "?"),
+        "wire_codec": slow.get("wire_codec", "f32"),
+        # the slower worker's breakdown: that is the rank the row's
+        # seconds_per_round actually measures
+        "stages_per_round_s": slow.get("stages_per_round_s", {}),
     }
 
 
@@ -485,6 +509,51 @@ def measure_crossgroup(
     return out
 
 
+def measure_compressed(
+    total_mb: float = 128.0, rounds: int = 2
+) -> Dict[str, object]:
+    """The ``crossgroup_compressed`` bench row: the int8-quantized wire
+    (4x fewer bytes per hop, per-chunk scale factors, error feedback
+    handled one level up) over the forced tcp-striped native plane —
+    ``TORCHFT_DP_CMA=0`` models the cross-host link, where CMA does not
+    exist and compression is the whole point. ``serial`` is the
+    round-2 schedule; ``streamed`` is the per-bucket pipeline that
+    overlaps host-copy / wire / H2D per bucket. ``gb_per_sec`` counts
+    APPLICATION bytes (the f32 gradient tree), so the row composes with
+    derived_llama2_7b_avg_s and the uncompressed rows directly."""
+    from torchft_tpu.coordination import LighthouseServer
+
+    out: Dict[str, object] = {
+        "topology": "2 replica groups, separate OS processes, int8 wire "
+        "codec on the forced tcp-striped native plane (TORCHFT_DP_CMA=0 "
+        "— the cross-host model); gb_per_sec counts f32 tree bytes",
+        "tree_mb": total_mb,
+        "codec": "int8",
+    }
+    grad_bytes_7b = LLAMA2_7B_PARAMS * 4
+    for name, serial in (("serial", True), ("streamed", False)):
+        lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+        try:
+            res = _run_pair(
+                lighthouse.address(), total_mb, rounds,
+                wire_dtype="int8", serial=serial,
+                env_extra={"TORCHFT_DP_CMA": "0"},
+            )
+        except Exception as e:  # noqa: BLE001 — best-effort matrix row
+            out[name] = {"error": str(e)}
+            continue
+        finally:
+            lighthouse.shutdown()
+        res["derived_llama2_7b_avg_s"] = round(
+            grad_bytes_7b * res["seconds_per_round"] / res["total_bytes"], 2
+        )
+        res["seconds_per_round"] = round(res["seconds_per_round"], 4)
+        res["gb_per_sec"] = round(res["gb_per_sec"], 3)
+        del res["total_bytes"]
+        out[name] = res
+    return out
+
+
 def main() -> None:
     if "--heal-worker" in sys.argv:
         argv = [a for a in sys.argv[1:] if a != "--heal-worker"]
@@ -501,9 +570,15 @@ def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--total-mb", type=float, default=256.0)
     parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument(
+        "--compressed", action="store_true",
+        help="run only the crossgroup_compressed matrix (int8 wire, "
+        "serial + streamed)",
+    )
     args = parser.parse_args()
     # ONE line: callers (bench.py) parse the last stdout line as JSON
-    print(json.dumps(measure_crossgroup(args.total_mb, args.rounds)), flush=True)
+    fn = measure_compressed if args.compressed else measure_crossgroup
+    print(json.dumps(fn(args.total_mb, args.rounds)), flush=True)
 
 
 if __name__ == "__main__":

@@ -42,6 +42,7 @@ import numpy as np
 from torchft_tpu.faultinject.core import fault_point
 from torchft_tpu.futures import Future
 from torchft_tpu.store import create_store_client
+from torchft_tpu.wire_codec import WireCodec, get_codec
 
 logger = logging.getLogger(__name__)
 
@@ -54,7 +55,44 @@ __all__ = [
     "ErrorSwallowingCollectives",
     "ManagedCollectives",
     "PeerGoneError",
+    "record_wire_stage",
+    "wire_stage_snapshot",
 ]
+
+
+# ---------------------------------------------------------------------------
+# Per-stage wall-clock accounting for the cross-group wire plane
+# (docs/wire_plane.md): host-copy / quantize / wire / dequantize-reduce.
+# The crossgroup bench reads these to attribute its gb_per_sec deltas to a
+# stage instead of reporting an unexplained total (the old
+# pipelined_bf16_wire row's 8.4%-only delta was exactly such a mystery).
+# ---------------------------------------------------------------------------
+
+WIRE_STAGES = ("host_copy", "quantize", "wire", "dequant_reduce")
+_wire_stage_lock = threading.Lock()
+_wire_stage_s: Dict[str, float] = {}
+
+
+def record_wire_stage(stage: str, seconds: float) -> None:
+    """Accumulate wall-clock into a wire-plane stage bucket (also mirrored
+    to the ``tft_wire_stage_seconds_total`` metric family)."""
+    if seconds <= 0.0:
+        return
+    with _wire_stage_lock:
+        _wire_stage_s[stage] = _wire_stage_s.get(stage, 0.0) + seconds
+    from torchft_tpu import telemetry
+
+    telemetry.WIRE_STAGE_SECONDS.labels(stage=stage).inc(seconds)
+
+
+def wire_stage_snapshot(reset: bool = False) -> Dict[str, float]:
+    """Process-cumulative seconds per wire-plane stage; ``reset`` zeroes
+    the local accumulators (the telemetry counters stay monotonic)."""
+    with _wire_stage_lock:
+        out = dict(_wire_stage_s)
+        if reset:
+            _wire_stage_s.clear()
+    return out
 
 
 class PeerGoneError(ConnectionError):
@@ -174,6 +212,13 @@ class Collectives(ABC):
         their live routing (e.g. CollectivesTcp: cma / tcp-striped /
         python-ring). Wrappers must delegate to the inner backend."""
         return type(self).__name__
+
+    def wire_codec(self) -> str:
+        """Name of the codec large f32 allreduces ride the wire with
+        (``"f32"`` = exact). Lossy codecs ("bfloat16"/"int8") are what
+        :class:`~torchft_tpu.wire_codec.ErrorFeedback` compensates for;
+        wrappers must delegate to the inner backend."""
+        return "f32"
 
     def shutdown(self) -> None:  # noqa: B027 — optional hook
         pass
@@ -358,10 +403,17 @@ class CollectivesTcp(Collectives):
     ) -> None:
         """
         Args:
-            wire_dtype: optional on-the-wire compression for float32 ring
-                allreduce — ``"bfloat16"`` halves DCN bytes; partial sums
-                are re-quantized each hop (error ~O(sqrt(world))·2^-8), so
-                it's opt-in, like the reference's NCCL bf16 gradient comms.
+            wire_dtype: optional on-the-wire compression for float32
+                allreduce — a codec name from
+                :mod:`torchft_tpu.wire_codec`: ``"bfloat16"`` halves DCN
+                bytes, ``"int8"`` quarters them (per-chunk scale factors);
+                partial sums are re-quantized each hop, accumulation stays
+                f32, and the decoded average is bit-identical on every
+                rank by construction (the allgather phase forwards the
+                chunk owner's wire bytes verbatim). Defaults to the
+                ``TORCHFT_WIRE_CODEC`` env knob, else exact f32. Opt-in,
+                like the reference's NCCL bf16 gradient comms; pair lossy
+                codecs with error feedback (docs/wire_plane.md).
             p2p_workers: thread pool size for send/recv ops — point-to-point
                 transfers (checkpoint fan-out to several healing replicas,
                 windowed buffer pipelines) run concurrently, off the ordered
@@ -393,15 +445,12 @@ class CollectivesTcp(Collectives):
         self._death_watch_cb: Optional[Callable[[int, int], None]] = None
         self._timeout = timeout
         self._hostname = hostname or socket.gethostname()
-        if wire_dtype:
-            try:
-                self._wire_dtype: Optional[np.dtype] = np.dtype(wire_dtype)
-            except TypeError:
-                import ml_dtypes  # noqa: F401 — registers bfloat16/fp8 names
-
-                self._wire_dtype = np.dtype(wire_dtype)
-        else:
-            self._wire_dtype = None
+        if wire_dtype is None:
+            wire_dtype = _os.environ.get("TORCHFT_WIRE_CODEC") or None
+        self._codec: WireCodec = get_codec(wire_dtype or None)
+        # per-epoch wire scratch (grown monotonically, cleared on
+        # teardown): the ring must never allocate per chunk per round
+        self._scratch_bufs: Dict[str, np.ndarray] = {}
         self._p2p_workers = p2p_workers
         self._stash_limit = stash_limit
         self._rank = -1
@@ -673,6 +722,25 @@ class CollectivesTcp(Collectives):
             return "python-ring"
         return "cma" if getattr(self, "_dp_cma", False) else "tcp-striped"
 
+    def wire_codec(self) -> str:
+        # the CMA transport pulls exact f32 out of the peer's memory, so
+        # a configured lossy codec is bypassed there (docs/wire_plane.md)
+        if self._dp is not None and getattr(self, "_dp_cma", False):
+            return "f32"
+        return self._codec.name
+
+    def _epoch_scratch(self, dtype: np.dtype, nelems: int,
+                       slot: str = "") -> np.ndarray:
+        """Per-epoch reusable scratch (grown monotonically, torn down
+        with the epoch): the old ring's ``astype`` per chunk per round
+        allocated on the hot path."""
+        key = f"{slot}:{np.dtype(dtype).str}"
+        buf = self._scratch_bufs.get(key)
+        if buf is None or buf.size < nelems:
+            buf = np.empty(max(nelems, 1), dtype=dtype)
+            self._scratch_bufs[key] = buf
+        return buf[:nelems]
+
     def _wait_for_peers(self, expected: set) -> None:
         import time
 
@@ -769,6 +837,9 @@ class CollectivesTcp(Collectives):
         if self._p2p is not None:
             self._p2p.shutdown(wait=True, cancel_futures=True)
             self._p2p = None
+        # after the executors have drained: no op thread can still be
+        # writing through these views
+        self._scratch_bufs.clear()
         if self._store is not None:
             self._store.close()
             self._store = None
@@ -1188,33 +1259,38 @@ class CollectivesTcp(Collectives):
         return self._track_flight(self._submit(run, op="allreduce"), fid)
 
     def _dp_eligible(self, arr: np.ndarray) -> bool:
-        # wire_dtype other than bfloat16 isn't implemented natively; such
-        # configs keep the Python ring so the compression contract holds
-        return (
-            self._dp is not None
-            and arr.dtype == np.float32
-            and arr.flags["C_CONTIGUOUS"]
-            and (self._wire_dtype is None or self._wire_dtype.name == "bfloat16")
-        )
+        if (
+            self._dp is None
+            or arr.dtype != np.float32
+            or not arr.flags["C_CONTIGUOUS"]
+        ):
+            return False
+        # the codec-name → DpCodec map lives ONCE, on the binding
+        # (NativeDataPlane.CODEC); a Python-only codec with no native
+        # twin keeps the Python ring so the compression contract holds
+        from torchft_tpu._native import NativeDataPlane
+
+        return self._codec.name in NativeDataPlane.CODEC
 
     def _dp_allreduce(self, arr: np.ndarray, op: ReduceOp, tag: int) -> None:
-        """Hot path: the striped C++ ring (AVG divides natively; bf16 wire
-        when wire_dtype is bfloat16, with the same deterministic owner
-        round-trip as the Python ring)."""
+        """Hot path: the striped C++ ring (AVG divides natively; the wire
+        codec — bf16 or int8 — runs in C++, with the same owner-bytes
+        verbatim allgather as the Python ring so the decoded average is
+        bit-identical on every rank)."""
+        import time as _time
+
         from torchft_tpu._native import DataPlaneError
 
-        wire_bf16 = (
-            self._wire_dtype is not None and self._wire_dtype.name == "bfloat16"
-        )
         dp = self._dp  # teardown may None the field mid-op
         if dp is None:
             raise RuntimeError("data plane torn down")
+        t0 = _time.perf_counter()
         try:
             dp.allreduce(
                 arr.ctypes.data,
                 arr.size,
                 op.value,
-                wire_bf16,
+                self._codec.name,  # resolved via NativeDataPlane.CODEC
                 tag,
                 int(self._timeout.total_seconds() * 1000),
             )
@@ -1222,6 +1298,10 @@ class CollectivesTcp(Collectives):
             if e.peer_rank >= 0:
                 raise PeerGoneError(e.peer_rank, str(e)) from e
             raise
+        finally:
+            # codec work happens inside the C++ stripe workers and is not
+            # separable from here; the whole native op lands in "wire"
+            record_wire_stage("wire", _time.perf_counter() - t0)
 
     def _ring_allreduce(self, arr: np.ndarray, op: ReduceOp, tag: int) -> None:
         world, rank = self._world, self._rank
@@ -1232,19 +1312,22 @@ class CollectivesTcp(Collectives):
         flat = _flat_view(arr)
         bounds = np.linspace(0, flat.size, world + 1).astype(np.int64)
         chunks = [flat[bounds[i] : bounds[i + 1]] for i in range(world)]
-
-        # optional lossy wire compression (f32 → bf16 on the wire, f32
-        # accumulation locally): halves DCN bytes per hop
-        wire = self._wire_dtype
-        compress = wire is not None and arr.dtype == np.float32 and flat.size > 0
         max_elems = max((int(c.size) for c in chunks), default=0)
-        if compress:
-            scratch = np.empty(max_elems, dtype=wire)
-        else:
-            scratch = np.empty(max_elems, dtype=arr.dtype)
 
-        def pack(chunk: np.ndarray) -> memoryview:
-            return _bytes_view(chunk.astype(wire) if compress else chunk)
+        # optional lossy wire codec (f32 → bf16/int8 on the wire, f32
+        # accumulation locally; wire_codec.py): 2-4x fewer DCN bytes/hop
+        codec = self._codec
+        lossy = codec.lossy and arr.dtype == np.float32 and flat.size > 0
+        if lossy:
+            self._ring_allreduce_codec(
+                arr, op, tag, chunks, max_elems, reduce_fn
+            )
+            return
+
+        import time as _time
+
+        scratch = self._epoch_scratch(arr.dtype, max_elems)
+        t_wire = 0.0
 
         # reduce-scatter phase
         for step in range(world - 1):
@@ -1252,31 +1335,107 @@ class CollectivesTcp(Collectives):
             recv_idx = (rank - step - 1) % world
             n = int(chunks[recv_idx].size)
             view = scratch[:n]
+            t0 = _time.perf_counter()
             self._exchange(
-                right, pack(chunks[send_idx]), left, tag, into=_bytes_view(view)
+                right, _bytes_view(chunks[send_idx]), left, tag,
+                into=_bytes_view(view),
             )
-            incoming = view.astype(np.float32) if compress else view
-            reduce_fn(chunks[recv_idx], incoming.reshape(chunks[recv_idx].shape))
-        # With lossy wire compression the owner of each fully reduced chunk
-        # must hold the same wire-rounded value every other rank receives,
-        # or ranks silently diverge (the owner keeps full f32 while peers
-        # store the bf16-rounded copy).  Round-trip the owned chunk through
-        # the wire dtype before the allgather phase so the result is
-        # bitwise identical on every rank.
-        if compress:
-            owned = chunks[(rank + 1) % world]
-            owned[:] = owned.astype(wire).astype(arr.dtype)
-        # allgather phase
+            t_wire += _time.perf_counter() - t0
+            reduce_fn(chunks[recv_idx], view.reshape(chunks[recv_idx].shape))
+        # allgather phase (raw bytes: every rank forwards the owner's
+        # exact bytes, so the result is bitwise identical by construction)
         for step in range(world - 1):
             send_idx = (rank + 1 - step) % world
             recv_idx = (rank - step) % world
             n = int(chunks[recv_idx].size)
             view = scratch[:n]
+            t0 = _time.perf_counter()
             self._exchange(
-                right, pack(chunks[send_idx]), left, tag, into=_bytes_view(view)
+                right, _bytes_view(chunks[send_idx]), left, tag,
+                into=_bytes_view(view),
             )
-            incoming = view.astype(arr.dtype) if compress else view
-            chunks[recv_idx][:] = incoming.reshape(chunks[recv_idx].shape)
+            t_wire += _time.perf_counter() - t0
+            chunks[recv_idx][:] = view.reshape(chunks[recv_idx].shape)
+        record_wire_stage("wire", t_wire)
+
+    def _ring_allreduce_codec(
+        self, arr: np.ndarray, op: ReduceOp, tag: int,
+        chunks: List[np.ndarray], max_elems: int, reduce_fn,
+    ) -> None:
+        """Lossy-codec ring. Reduce-scatter ships freshly encoded partial
+        sums per hop (re-quantized at each hop's own magnitude, residual
+        handled one level up by error feedback); the allgather phase then
+        forwards the chunk OWNER's wire bytes verbatim — decode work per
+        rank, zero re-encode work, and bit-identity of the decoded average
+        on every rank by construction rather than by fp-rounding luck."""
+        import time as _time
+
+        world, rank = self._world, self._rank
+        right = (rank + 1) % world
+        left = (rank - 1) % world
+        codec = self._codec
+        codec.ensure_capacity(max_elems)
+        max_wire = codec.wire_nbytes(max_elems)
+        # double buffer: at each allgather hop one holds the bytes being
+        # forwarded while the other receives the next chunk's bytes
+        buf_a = self._epoch_scratch(np.uint8, max_wire, slot="wireA")
+        buf_b = self._epoch_scratch(np.uint8, max_wire, slot="wireB")
+        t_quant = t_wire = t_dq = 0.0
+
+        # reduce-scatter phase
+        for step in range(world - 1):
+            send_idx = (rank - step) % world
+            recv_idx = (rank - step - 1) % world
+            n = int(chunks[recv_idx].size)
+            rn = codec.wire_nbytes(n)
+            t0 = _time.perf_counter()
+            sv = codec.encode_into(chunks[send_idx])
+            t1 = _time.perf_counter()
+            rv = buf_a[:rn]
+            self._exchange(right, sv, left, tag, into=_bytes_view(rv))
+            t2 = _time.perf_counter()
+            incoming = codec.decode_tmp(rv, n)
+            reduce_fn(
+                chunks[recv_idx], incoming.reshape(chunks[recv_idx].shape)
+            )
+            t3 = _time.perf_counter()
+            t_quant += t1 - t0
+            t_wire += t2 - t1
+            t_dq += t3 - t2
+
+        # the owner of each fully reduced chunk encodes it ONCE; those
+        # bytes circulate verbatim, and the owner itself keeps the decode
+        # of its own bytes — every rank ends with the identical f32 image
+        t0 = _time.perf_counter()
+        owned = chunks[(rank + 1) % world]
+        ow = codec.encode_into(owned)
+        cur = buf_b[: len(ow)]
+        cur[:] = np.frombuffer(ow, dtype=np.uint8)
+        codec.decode_into(cur, owned)
+        t_quant += _time.perf_counter() - t0
+
+        # allgather phase: forward received wire bytes untouched
+        bufs = (buf_a, buf_b)
+        cur_view: np.ndarray = cur
+        cur_i = 1  # cur lives in buf_b; buf_a is free to receive into
+        for step in range(world - 1):
+            recv_idx = (rank - step) % world
+            n = int(chunks[recv_idx].size)
+            rn = codec.wire_nbytes(n)
+            rv = bufs[1 - cur_i][:rn]
+            t0 = _time.perf_counter()
+            self._exchange(
+                right, _bytes_view(cur_view), left, tag, into=_bytes_view(rv)
+            )
+            t1 = _time.perf_counter()
+            codec.decode_into(rv, chunks[recv_idx])
+            t_dq += _time.perf_counter() - t1
+            t_wire += t1 - t0
+            # rv is next hop's outgoing frame; the old cur buffer is free
+            cur_view, cur_i = rv, 1 - cur_i
+        record_wire_stage("quantize", t_quant)
+        record_wire_stage("wire", t_wire)
+        record_wire_stage("dequant_reduce", t_dq)
 
     def allgather(self, arr: np.ndarray) -> Work:
         world, rank = self._world, self._rank
@@ -1489,6 +1648,9 @@ class ErrorSwallowingCollectives(Collectives):
     def plane_info(self) -> str:
         return self._inner.plane_info()
 
+    def wire_codec(self) -> str:
+        return self._inner.wire_codec()
+
     def report_error(self, e: Exception) -> None:
         self._error = e
 
@@ -1564,6 +1726,9 @@ class ManagedCollectives(Collectives):
 
     def __init__(self, manager) -> None:
         self._manager = manager
+
+    def wire_codec(self) -> str:
+        return self._manager.wire_codec()
 
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
         raise RuntimeError("ManagedCollectives is configured by its Manager")

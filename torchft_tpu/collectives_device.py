@@ -270,6 +270,11 @@ class CollectivesDevice(Collectives):
         """Dashboard label: in-process device mesh ('ft' psum over ICI)."""
         return "device"
 
+    def wire_codec(self) -> str:
+        """The ICI psum moves exact device bytes — no wire codec applies,
+        so error feedback is a no-op on this plane (docs/wire_plane.md)."""
+        return "f32"
+
     # -- rendezvous plumbing --
 
     def _next_tag(self) -> int:

@@ -30,13 +30,28 @@ gradient pytree across a rollback/replay.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Sequence, Tuple
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 __all__ = ["flatten_buckets", "unflatten_buckets", "allreduce_gradients"]
 
 _DEFAULT_BUCKET_BYTES = 25 * 1024 * 1024
+
+
+def default_bucket_bytes() -> int:
+    """Streamed-bucket size for the host wire plane — the
+    ``TORCHFT_WIRE_BUCKET_BYTES`` env knob, default 25 MB
+    (docs/wire_plane.md: smaller buckets start the wire earlier but pay
+    more per-op overhead)."""
+    raw = os.environ.get("TORCHFT_WIRE_BUCKET_BYTES")
+    if raw:
+        try:
+            return max(1 << 16, int(raw))
+        except ValueError:
+            pass
+    return _DEFAULT_BUCKET_BYTES
 
 
 def _leaves(tree: Any) -> Tuple[List[Any], Any]:
@@ -121,7 +136,8 @@ class _Item:
 def allreduce_gradients(
     manager,
     grads: Any,
-    bucket_bytes: int = _DEFAULT_BUCKET_BYTES,
+    bucket_bytes: Optional[int] = None,
+    error_feedback: Optional[Any] = None,
 ) -> Any:
     """Average a gradient pytree across replica groups through the Manager.
 
@@ -139,9 +155,19 @@ def allreduce_gradients(
 
     Both scale by ``1/num_participants()`` and swallow errors into the
     Manager's latched state.
+
+    ``error_feedback`` (a :class:`~torchft_tpu.wire_codec.ErrorFeedback`,
+    host path only): each bucket is compensated with the committed
+    residual, projected onto the wire codec's grid, and its fresh
+    residual STAGED — the caller promotes or discards it with the step's
+    fate (``commit()``/``rollback()``; ManagedOptimizer wires this
+    automatically). ``bucket_bytes`` defaults to the
+    ``TORCHFT_WIRE_BUCKET_BYTES`` knob.
     """
     import jax
 
+    if bucket_bytes is None:
+        bucket_bytes = default_bucket_bytes()
     leaves, treedef = _leaves(grads)
 
     if getattr(manager, "device_data_plane", lambda: False)():
@@ -188,10 +214,15 @@ def allreduce_gradients(
 
     plan = plan_buckets([(it.dtype, it.nbytes) for it in items], bucket_bytes)
 
-    def _run_bucket(idxs: List[int]):
+    def _run_bucket(ordinal: int, idxs: List[int]):
+        import time as _time
+
+        from torchft_tpu.collectives import record_wire_stage
+
         # stage 1 (main thread): materialize this bucket's host buffers —
         # blocks only on *this* bucket's D2H while earlier buckets are
         # already riding the ring on the op thread
+        t0 = _time.perf_counter()
         flat = [
             np.ascontiguousarray(np.asarray(items[i].src)).reshape(-1)
             for i in idxs
@@ -200,6 +231,19 @@ def allreduce_gradients(
         # non-participants zero) in place, which must never write through
         # a view of the caller's arrays or a read-only XLA host buffer
         buf = np.concatenate(flat) if len(flat) > 1 else flat[0].copy()
+        record_wire_stage("host_copy", _time.perf_counter() - t0)
+
+        if error_feedback is not None:
+            # compensate with the committed residual and project onto the
+            # codec's bucket-level grid BEFORE the collective (exact for
+            # bf16; int8's per-chunk wire scales add a finer bounded
+            # component EF doesn't track — see ErrorFeedback docstring);
+            # the fresh residual stays PENDING until the step's fate
+            # resolves. The key is stable across steps as long as the
+            # bucket plan is (same tree -> same plan).
+            t0 = _time.perf_counter()
+            error_feedback.apply(f"b{ordinal}_{buf.size}", buf)
+            record_wire_stage("quantize", _time.perf_counter() - t0)
 
         # stage 2 (op thread): quorum-managed ring allreduce of the bucket
         fut = manager.allreduce_many([buf])
@@ -235,7 +279,10 @@ def allreduce_gradients(
 
         return fut.then(scatter)
 
-    bucket_futs = [(idxs, _run_bucket(idxs)) for idxs in plan]
+    bucket_futs = [
+        (idxs, _run_bucket(ordinal, idxs))
+        for ordinal, idxs in enumerate(plan)
+    ]
 
     # collect averaged pieces per item (in order; waits overlap the tail)
     item_out: List[np.ndarray] = [None] * len(items)  # type: ignore[list-item]

@@ -126,6 +126,29 @@ SCENARIOS: List[Scenario] = [
         victim_env={"TORCHFT_FI_DP_CUT": "3:0.5"},
     ),
     Scenario(
+        name="kill_streamed_bucket",
+        description="victim SIGKILLed entering a striped hop while the "
+        "int8-compressed streamed buckets are in flight — the survivor's "
+        "step must latch+flush, and the error-feedback residuals staged "
+        "for the doomed step must roll back with the commit lineage "
+        "(asserted via final cross-group checksum bit-identity: a leaked "
+        "residual would diverge the next committed average)",
+        common_env={"TORCHFT_DP_CMA": "0", "TORCHFT_WIRE_CODEC": "int8"},
+        victim_env={"TORCHFT_FI_DP_KILL": "3"},
+        expect_victim_death=True,
+        quick=False,
+    ),
+    Scenario(
+        name="torn_compressed_frame",
+        description="a striped hop carrying an int8-compressed frame is "
+        "cut after half the payload (torn quantized wire): the receiver "
+        "must surface a mid-frame EOF — a partial scale+payload must "
+        "never dequantize into a committed average — and the aborted "
+        "step's error-feedback residuals must not leak",
+        common_env={"TORCHFT_DP_CMA": "0", "TORCHFT_WIRE_CODEC": "int8"},
+        victim_env={"TORCHFT_FI_DP_CUT": "3:0.5"},
+    ),
+    Scenario(
         name="torn_cma_pull",
         description="a CMA pull stops halfway (torn read — the ROADMAP "
         "checksum-divergence hypothesis); partial bytes must never "

@@ -838,6 +838,14 @@ class Manager:
         — gradient averaging then skips the host round trip entirely."""
         return bool(getattr(self._collectives, "device_arrays", False))
 
+    def wire_codec(self) -> str:
+        """Name of the codec the configured data plane ships large f32
+        allreduces with (``"f32"`` = exact). ``ManagedOptimizer`` keys its
+        automatic error-feedback enablement off this — a lossy wire
+        without residual compensation drifts (docs/wire_plane.md)."""
+        fn = getattr(self._collectives, "wire_codec", None)
+        return fn() if callable(fn) else "f32"
+
     def allreduce(self, tensor: np.ndarray) -> Future:
         """Fault-tolerant cross-replica-group allreduce of one buffer,
         scaled by ``1 / num_participants()``; see :meth:`allreduce_many`."""

@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from torchft_tpu.ddp import allreduce_gradients
 from torchft_tpu.manager import Manager
+from torchft_tpu.wire_codec import ErrorFeedback, ErrorFeedbackBinding
 
 __all__ = ["ManagedOptimizer"]
 
@@ -55,6 +56,7 @@ class SpeculativeCommitMixin:
 
     _snapshot: Optional[Tuple[Any, Any]] = None
     _replay_needed = False
+    _efb: Optional[ErrorFeedbackBinding] = None  # wire-plane error feedback
     rollbacks = 0  # speculative steps undone by a veto
 
     def _on_vote_resolved(self, committed: bool) -> None:
@@ -66,6 +68,14 @@ class SpeculativeCommitMixin:
             self.rollbacks += 1
             self._replay_needed = True
         self._snapshot = None
+        # error-feedback residuals share the commit lineage: a vetoed
+        # step's staged residual must never compensate the next step
+        ef = self._efb.instance if self._efb is not None else None
+        if ef is not None:
+            if committed:
+                ef.commit()
+            else:
+                ef.rollback()
 
     def _consume_replay(self) -> bool:
         """True once per rollback: the current in-flight gradients were
@@ -85,13 +95,26 @@ class SpeculativeCommitMixin:
 
 
 class ManagedOptimizer(SpeculativeCommitMixin):
-    def __init__(self, manager: Manager, tx, register_state: bool = True) -> None:
+    def __init__(
+        self,
+        manager: Manager,
+        tx,
+        register_state: bool = True,
+        error_feedback: "Optional[ErrorFeedback | bool]" = None,
+    ) -> None:
         """``tx`` is an ``optax.GradientTransformation``. With
         ``register_state`` (default) ``init`` wires this wrapper's
         state_dict/load_state_dict into the manager so live recovery
         restores params and optimizer state automatically; pass False if the
         user snapshot covers more than the optimizer (then include
-        ``opt.state_dict()`` in it)."""
+        ``opt.state_dict()`` in it).
+
+        ``error_feedback``: residual compensation for a lossy wire codec
+        (docs/wire_plane.md). Default (None) AUTO-enables when the
+        manager's data plane reports a lossy codec — the convergence-
+        preserving configuration — unless ``TORCHFT_WIRE_EF=0``; pass
+        ``False`` to force off or a prebuilt
+        :class:`~torchft_tpu.wire_codec.ErrorFeedback` to share one."""
         self._manager = manager
         self._tx = tx
         self._register_state = register_state
@@ -102,6 +125,16 @@ class ManagedOptimizer(SpeculativeCommitMixin):
         self._snapshot = None
         self._replay_needed = False
         self.rollbacks = 0
+        # wire-plane error feedback (accumulators ride state_dict through
+        # heal/checkpoint; pending residuals follow the commit lineage)
+        # auto/lazy/CMA-gate semantics live in the shared binding
+        # (wire_codec.ErrorFeedbackBinding) — LocalSGD resolves the same
+        # way, so the two wrappers cannot drift
+        self._efb = ErrorFeedbackBinding(manager, error_feedback)
+
+    @property
+    def error_feedback(self) -> Optional[ErrorFeedback]:
+        return self._efb.instance if self._efb is not None else None
 
     # -- state --
 
@@ -124,8 +157,16 @@ class ManagedOptimizer(SpeculativeCommitMixin):
         snap = self._snapshot
         if snap is not None:
             # mid-speculation a peer must heal from COMMITTED state
-            return {"params": snap[0], "opt_state": snap[1]}
-        return {"params": self._params, "opt_state": self._opt_state}
+            out = {"params": snap[0], "opt_state": snap[1]}
+        else:
+            out = {"params": self._params, "opt_state": self._opt_state}
+        ef = self.error_feedback
+        if ef is not None:
+            # committed residuals only (state_dict() on ErrorFeedback
+            # excludes pending) — a heal/checkpoint restart must resume
+            # the compensation stream, not restart it from zero
+            out["ef"] = ef.state_dict()
+        return out
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self._params = state["params"]
@@ -135,6 +176,19 @@ class ManagedOptimizer(SpeculativeCommitMixin):
         # state, so they are valid, not vetoed-lineage leftovers
         self._snapshot = None
         self._replay_needed = False
+        ef = self.error_feedback
+        if ef is None and "ef" in state and self._efb is not None:
+            # lazy auto mode (e.g. proxied backend): the heal may land
+            # before the first live() — adopt the state's accumulators,
+            # don't drop them
+            ef = self._efb.ensure_for_state(state["ef"])
+        if ef is not None:
+            if "ef" in state:
+                ef.load_state_dict(state["ef"])
+            else:
+                # healed from a peer without EF state: start clean rather
+                # than compensate with residuals of a dead lineage
+                ef.load_state_dict({"codec": None, "acc": {}})
 
     # -- step --
 
@@ -163,6 +217,7 @@ class ManagedOptimizer(SpeculativeCommitMixin):
             # resolve the previous step's vote before this step's
             # collectives/commit (at most one speculative step outstanding)
             m.resolve_pending_commit()
+        ef = self._efb.live()
         if self._consume_replay():
             # a rollback happened — here or out-of-band (an average=False
             # caller resolves before its own manager.allreduce): ``grads``
@@ -173,9 +228,11 @@ class ManagedOptimizer(SpeculativeCommitMixin):
                 return self._params
             # fresh grads always go through the managed average — any
             # pre-averaging the caller did belongs to the vetoed lineage
-            grads = allreduce_gradients(m, grad_fn(self._params))
+            grads = allreduce_gradients(
+                m, grad_fn(self._params), error_feedback=ef
+            )
         elif average:
-            grads = allreduce_gradients(m, grads)
+            grads = allreduce_gradients(m, grads, error_feedback=ef)
         if m.speculation_allowed():
             # publish the snapshot before the speculative apply so a
             # concurrent checkpoint serve never sees mid-update trees
@@ -183,12 +240,23 @@ class ManagedOptimizer(SpeculativeCommitMixin):
             self._params, self._opt_state = self._apply_update(
                 self._params, self._opt_state, grads
             )
+            # the staged EF residual stays PENDING with the vote; it is
+            # promoted/discarded in _on_vote_resolved with the lineage
             m.should_commit_async(on_resolved=self._on_vote_resolved)
             return self._params
         committed = m.should_commit()
         # should_commit may have healed: self._params now reflects the
         # recovered state; the gradient applied to it is the participants'
         # average (a healing replica contributed zeros)
+        ef_inst = self.error_feedback
+        if ef_inst is not None:
+            # heal inside should_commit restored EF state already (via
+            # load_state_dict); commit/rollback is then a no-op on the
+            # cleared pending set
+            if committed:
+                ef_inst.commit()
+            else:
+                ef_inst.rollback()
         if committed:
             self._params, self._opt_state = self._apply_update(
                 self._params, self._opt_state, grads

@@ -145,9 +145,9 @@ def _worker(factory, store_addr, rank, world_size, tx, rx) -> None:
                         buf.close()
                     except BufferError:
                         pass  # views freed with the op; mapping dies with us
-            elif name == "plane_info":
+            elif name in ("plane_info", "wire_codec"):
                 # metadata query, not an op: returns a plain string
-                result = backend.plane_info()
+                result = getattr(backend, name)()
             else:
                 work = getattr(backend, name)(*args, **kwargs)
                 result = work.wait()
@@ -167,6 +167,11 @@ class CollectivesProxy(Collectives):
         # being lost; ADVICE r5 #2)
         inner = self._inner_plane
         return f"proxy:{inner}" if inner else "proxy"
+
+    def wire_codec(self) -> str:
+        # fetched with the plane label at configure: the codec the child
+        # backend actually rides (error feedback keys off it)
+        return self._inner_codec or "f32"
 
     def __init__(
         self,
@@ -189,6 +194,7 @@ class CollectivesProxy(Collectives):
         self._lock = threading.Lock()
         self._drain: Optional[threading.Thread] = None
         self._inner_plane = ""  # child backend's live plane label
+        self._inner_codec = ""  # child backend's live wire codec
 
     def configure(self, store_addr: str, rank: int, world_size: int) -> None:
         self.shutdown()
@@ -225,12 +231,19 @@ class CollectivesProxy(Collectives):
         # where a backend settles its transport (e.g. CMA probe fails →
         # TCP), so one RPC here keeps plane_info() truthful and free
         self._inner_plane = ""
+        self._inner_codec = ""
         try:
             from torchft_tpu.futures import future_wait
 
             self._inner_plane = str(
                 future_wait(
                     self._submit("plane_info").get_future(),
+                    timedelta(seconds=5),
+                )
+            )
+            self._inner_codec = str(
+                future_wait(
+                    self._submit("wire_codec").get_future(),
                     timedelta(seconds=5),
                 )
             )

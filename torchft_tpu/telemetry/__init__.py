@@ -178,6 +178,12 @@ COLLECTIVE_OPS = REGISTRY.counter(
     "Cross-group collective ops issued, by op and data plane",
     labelnames=("op", "plane"),
 )
+WIRE_STAGE_SECONDS = REGISTRY.counter(
+    "tft_wire_stage_seconds_total",
+    "Cumulative wall-clock inside the cross-group wire plane, by stage "
+    "(host_copy / quantize / wire / dequant_reduce — docs/wire_plane.md)",
+    labelnames=("stage",),
+)
 
 # checkpoint transfers
 CHECKPOINT_BYTES = REGISTRY.counter(
@@ -247,7 +253,9 @@ for _result in ("evicted", "rejected", "failed"):
     EVICTIONS_REPORTED.labels(result=_result)
 for _reason in ("signal", "deadline", "watchdog", "manual"):
     FLIGHT_DUMPS.labels(reason=_reason)
-del _role, _outcome, _kind, _result, _reason
+for _stage in ("host_copy", "quantize", "wire", "dequant_reduce"):
+    WIRE_STAGE_SECONDS.labels(stage=_stage)
+del _role, _outcome, _kind, _result, _reason, _stage
 
 
 # ---------------------------------------------------------------------------
