@@ -13,6 +13,7 @@
 #include <string>
 
 #include "coord.h"
+#include "lathist.h"
 #include "rpc.h"
 #include "wire.h"
 
@@ -278,6 +279,43 @@ int64_t tft_quorum_compute(const uint8_t* state_buf, int64_t len, uint8_t** out,
     return INTERNAL;
   }
 }
+
+// ---- native latency histograms (lathist.h) ----
+
+// Snapshot every native latency histogram of THIS process as an encoded
+// Value map:
+//   { "<op>": { "counts": [I64 x 28], "count": I64, "sum_ns": I64 } }
+// Bucket bounds are the fixed log2 grid (2^-20 .. 2^6 s + overflow) shared
+// with telemetry.anatomy.LOG2_BUCKETS — identical in every process, so a
+// consumer merges two snapshots by elementwise count addition, exactly.
+int64_t tft_lathist_snapshot(uint8_t** out, int64_t* outlen, char* err,
+                             int errlen) {
+  try {
+    Value resp = Value::M();
+    for (int op = 0; op < lathist::kNumOps; ++op) {
+      const lathist::Hist& h = lathist::get((lathist::Op)op);
+      Value counts = Value::L();
+      for (int i = 0; i <= lathist::kNumBounds; ++i)
+        counts.list.push_back(Value::I(
+            (int64_t)h.counts[i].load(std::memory_order_relaxed)));
+      Value one = Value::M();
+      one.set("counts", counts);
+      one.set("count",
+              Value::I((int64_t)h.count.load(std::memory_order_relaxed)));
+      one.set("sum_ns",
+              Value::I((int64_t)h.sum_ns.load(std::memory_order_relaxed)));
+      resp.set(lathist::op_name(op), one);
+    }
+    std::string enc = encode(resp);
+    *out = alloc_out(enc, outlen);
+    return OK;
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return INTERNAL;
+  }
+}
+
+void tft_lathist_reset() { lathist::reset_all(); }
 
 // quorum_buf encodes a Quorum value. Response: ManagerQuorumResult map.
 int64_t tft_compute_quorum_results(const uint8_t* quorum_buf, int64_t len,

@@ -9,6 +9,7 @@
 #include <sstream>
 
 #include "faultinject.h"  // env-gated injection points (reply delay/drop)
+#include "lathist.h"      // quorum.fanout latency histogram + exports
 
 namespace tft {
 
@@ -424,11 +425,21 @@ void Lighthouse::ingest_telemetry(const std::string& replica_id,
   if (v.has("step")) t.step = v.geti("step", t.step);
   if (v.has("stuck")) t.stuck = v.getb("stuck", false);
   if (v.has("last_heal_ts")) t.last_heal_ts = v.at("last_heal_ts").f;
+  if (v.has("local_step_p50_s"))
+    t.local_step_p50_s = v.at("local_step_p50_s").f;
+  if (v.has("slo_breach")) t.slo_breach = v.getb("slo_breach", false);
   std::string summary = v.gets("summary");
   // minimal validation: the summary is spliced raw into /cluster.json, so
   // only accept something that at least looks like a JSON object
   if (!summary.empty() && summary.front() == '{' && summary.back() == '}')
     t.summary_json = std::move(summary);
+  // step-anatomy digest: same verbatim-splice contract as the summary
+  // (the lighthouse never parses the Python telemetry schema); size-
+  // capped — a malformed reporter must not grow the coordinator's store
+  std::string anatomy = v.gets("anatomy");
+  if (!anatomy.empty() && anatomy.size() <= (1u << 16) &&
+      anatomy.front() == '{' && anatomy.back() == '}')
+    t.anatomy_json = std::move(anatomy);
   std::string spans = v.gets("spans");
   if (!spans.empty() && spans.size() <= kMaxSpanBytesPerReplica) {
     t.span_batches.push_back(std::move(spans));
@@ -669,7 +680,8 @@ std::string Lighthouse::status_html() {
     // training loop refreshes it every step).
     o << "<h2>Replica health</h2><table border=1 cellpadding=4>"
          "<tr><th>replica_id</th><th>last report</th><th>step</th>"
-         "<th>last heal</th><th>stuck</th></tr>";
+         "<th>last heal</th><th>local p50</th><th>stuck</th>"
+         "<th>SLO</th></tr>";
     // two clocks on purpose: report ages use the monotonic clock that
     // stamped last_ms (mixing in wall time would show epoch-offset
     // garbage), while last_heal_ts is a unix timestamp from the replica
@@ -684,7 +696,12 @@ std::string Lighthouse::status_html() {
         o << (wall_now_s - t.last_heal_ts) << "s ago";
       else
         o << "never";
-      o << "</td><td>" << (t.stuck ? "STUCK" : "ok") << "</td></tr>";
+      o << "</td><td>" << t.local_step_p50_s << "s</td><td>"
+        << (t.stuck ? "STUCK" : "ok")
+        // the burn-rate SLO column (ISSUE 8): red next to the PR 2 STUCK
+        // flag, driven by the replica-side evaluator's piggybacked latch
+        << "</td><td" << (t.slo_breach ? " style=\"background:red\"" : "")
+        << ">" << (t.slo_breach ? "BREACH" : "ok") << "</td></tr>";
     }
     o << "</table><p><a href=\"/cluster.json\">cluster.json</a> | "
          "<a href=\"/trace\">merged trace (open in Perfetto)</a></p>";
@@ -720,11 +737,18 @@ std::string Lighthouse::cluster_json() {
     // timestamps in scientific notation with ~1000 s of rounding error
     char heal_ts[32];
     snprintf(heal_ts, sizeof heal_ts, "%.3f", t.last_heal_ts);
+    char p50[32];
+    snprintf(p50, sizeof p50, "%.6f", t.local_step_p50_s);
     o << "\"" << json_escape(id) << "\":{\"last_seen_ms_ago\":"
       << (now - t.last_ms) << ",\"step\":" << t.step
       << ",\"stuck\":" << (t.stuck ? "true" : "false")
-      << ",\"last_heal_ts\":" << heal_ts << ",\"summary\":"
+      << ",\"last_heal_ts\":" << heal_ts
+      << ",\"local_step_p50_s\":" << p50
+      << ",\"slo_breach\":" << (t.slo_breach ? "true" : "false")
+      << ",\"summary\":"
       << (t.summary_json.empty() ? "{}" : t.summary_json)
+      << ",\"anatomy\":"
+      << (t.anatomy_json.empty() ? "{}" : t.anatomy_json)
       << ",\"heartbeat_ms_ago\":";
     auto hb = state_.heartbeats.find(id);
     if (hb != state_.heartbeats.end())
@@ -819,6 +843,23 @@ std::string Lighthouse::handle_http(const std::string& method,
     for (const auto& [id, beat] : state_.heartbeats)
       o << "torchft_heartbeat_age_seconds{replica_id=\"" << prom_escape(id)
         << "\"} " << (now - beat) / 1000.0 << "\n";
+    if (!telemetry_.empty()) {
+      // step-anatomy scalars piggybacked by the replicas (ISSUE 8):
+      // local-step p50s feed the fleet straggler detector, slo_breach is
+      // the replica-side burn-rate evaluator's latch
+      o << "# TYPE torchft_replica_local_step_p50_seconds gauge\n";
+      for (const auto& [id, t] : telemetry_)
+        o << "torchft_replica_local_step_p50_seconds{replica_id=\""
+          << prom_escape(id) << "\"} " << t.local_step_p50_s << "\n";
+      o << "# TYPE torchft_slo_breach gauge\n";
+      for (const auto& [id, t] : telemetry_)
+        o << "torchft_slo_breach{replica_id=\"" << prom_escape(id) << "\"} "
+          << (t.slo_breach ? 1 : 0) << "\n";
+    }
+    // native latency histograms (lathist.h): whatever this process
+    // recorded — rpc.serve always; dp.* / quorum.fanout too when the
+    // lighthouse shares a process with a worker (in-process tests)
+    lathist::render_prometheus(o);
     return http_ok(o.str(), "text/plain; version=0.0.4");
   }
   if (method == "GET" && path == "/status.json") {
@@ -854,7 +895,11 @@ std::string Lighthouse::handle_http(const std::string& method,
       first = false;
       o << "\"" << json_escape(ev) << "\"";
     }
-    o << "]}";
+    o << "],\"latency\":";
+    // native latency histograms, raw per-bucket counts (fixed log2
+    // bounds, so merging counts across processes is exact addition)
+    lathist::render_json(o);
+    o << "}";
     return http_ok(o.str(), "application/json");
   }
   // POST /replica/{id}/kill → forward to that replica's manager
@@ -1054,8 +1099,17 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
     }
     // Like the reference (src/manager.rs:181 TODO), the lock is held for the
     // duration of the lighthouse call; peer handlers are parked in cv waits.
+    // quorum.fanout distribution: the full lh.quorum round trip — the
+    // long-poll until the fleet's quorum forms, i.e. the per-step control
+    // cost the HA roadmap item needs p50/p99-vs-group-count for
+    int64_t fanout_t0 = lathist::now_ns();
     try {
       Value resp = lighthouse_client_->call("lh.quorum", lreq, timeout_ms);
+      lathist::observe(lathist::kQuorumFanout,
+                       (double)(lathist::now_ns() - fanout_t0) / 1e9);
+      // mark recorded: a WireError from the parse below must not make
+      // the catch block observe the SAME round trip a second time
+      fanout_t0 = -1;
       Quorum q = Quorum::from_value(resp.at("quorum"));
       quorums_[++quorum_seq_] = q;
       quorum_error_.reset();
@@ -1069,6 +1123,9 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
       // seq bump and notify_all — every peer handler parked in the cv wait
       // below would stall until its own deadline [bugprone-exception-escape
       // class; flagged while wiring the clang-tidy gate].
+      if (fanout_t0 >= 0)
+        lathist::observe(lathist::kQuorumFanout,
+                         (double)(lathist::now_ns() - fanout_t0) / 1e9);
       quorum_error_ = std::string(e.what());
       quorum_seq_++;
     }

@@ -124,7 +124,15 @@ struct ReplicaTelemetry {
   int64_t step = -1;        // replica's committed step at report time
   bool stuck = false;       // step watchdog latched a stall
   double last_heal_ts = 0;  // unix seconds of the last heal (0 = never)
+  // Step-anatomy scalars (ISSUE 8): the replica's rolling p50 of LOCAL
+  // step time (wall minus peer-wait phases — the straggler-discriminating
+  // signal, computed replica-side by telemetry.anatomy), and the
+  // replica-side burn-rate SLO evaluator's latched breach flag (rendered
+  // as a red column next to STUCK).
+  double local_step_p50_s = 0;
+  bool slo_breach = false;
   std::string summary_json; // compact counters digest (JSON object)
+  std::string anatomy_json; // per-phase step-anatomy digest (JSON object)
   std::vector<std::string> span_batches;  // chrome trace-event fragments
   size_t span_bytes = 0;    // bytes across span_batches (for the cap)
 };

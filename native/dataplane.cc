@@ -19,6 +19,7 @@
 #include <stdexcept>
 
 #include "faultinject.h"  // env-gated injection points (torn hops, kills)
+#include "lathist.h"      // dp.hop / dp.stripe latency histograms
 #include "rpc.h"  // tcp_listen / tcp_connect / listen_port / now_ms
 
 namespace tft {
@@ -786,10 +787,18 @@ int DataPlane::run_stripe(int stripe_idx, Job& job, int* bad_peer,
   bool send_failed = false;
   bool timed_out = false;
   auto do_hop = [&](const uint8_t* sb, size_t sn, uint8_t* rb, size_t rn) {
-    return use_cma ? cma_hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
-                             job.deadline_ms, &send_failed, &timed_out, err)
-                   : hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
-                         job.deadline_ms, &send_failed, &timed_out, err);
+    // per-hop latency histogram (full-duplex send+recv pump — the wait
+    // for a slow left neighbor lands here, which is what makes the
+    // distribution a straggler lens); failed hops record too: a
+    // deadline'd hop's duration is exactly the evidence wanted
+    int64_t t0 = lathist::now_ns();
+    bool ok = use_cma ? cma_hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
+                                job.deadline_ms, &send_failed, &timed_out, err)
+                      : hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
+                            job.deadline_ms, &send_failed, &timed_out, err);
+    lathist::observe(lathist::kDpHop,
+                     (double)(lathist::now_ns() - t0) / 1e9);
+    return ok;
   };
   // a deadline or LOCAL shutdown names NO peer: slow-but-alive (or our
   // own teardown) must surface as retryable, not as an eviction-worthy
@@ -914,7 +923,13 @@ void DataPlane::worker_loop(int stripe_idx) {
     }
     int bad_peer = -1;
     std::string err;
-    int rc = job.nelems > 0 ? run_stripe(stripe_idx, job, &bad_peer, &err) : 0;
+    int rc = 0;
+    if (job.nelems > 0) {
+      int64_t t0 = lathist::now_ns();
+      rc = run_stripe(stripe_idx, job, &bad_peer, &err);
+      lathist::observe(lathist::kDpStripe,
+                       (double)(lathist::now_ns() - t0) / 1e9);
+    }
     {
       std::lock_guard<std::mutex> g(st.mu);
       st.rc = rc;
@@ -1025,10 +1040,12 @@ extern "C" {
 
 // Bumped whenever the ctypes-visible surface changes SHAPE or MEANING
 // (v2: tft_dp_allreduce's `wire_bf16` int became the DpCodec enum — a
-// stale library would silently reinterpret codec=2 as wire_bf16=true).
+// stale library would silently reinterpret codec=2 as wire_bf16=true;
+// v3: tft_lathist_snapshot/tft_lathist_reset added — a stale build would
+// fail the loader's symbol lookup at import).
 // The Python loader (_native/__init__.py) refuses to run a mismatched
 // build and rebuilds in place.
-int tft_abi_version() { return 2; }
+int tft_abi_version() { return 3; }
 
 int64_t tft_dp_create(int rank, int world, int nstripes, char* err,
                       int errlen) {

@@ -1,0 +1,188 @@
+// torchft_tpu native core — latency histograms for the hot native paths.
+//
+// The Python registry (torchft_tpu/telemetry/registry.py) can't see inside
+// the C++ plane: stripe hops, the RPC serve loop and the quorum fan-out all
+// run GIL-free, so until now the native side exported counters only — no
+// distributions (ISSUE 8). These histograms are the missing lens:
+//
+//   * fixed log2 bucket bounds (2^-20 s .. 2^6 s, one bucket per binary
+//     order of magnitude, + overflow) shared with the Python side's
+//     LOG2_BUCKETS — identical bounds in every process make cross-process
+//     merging EXACT: merge = elementwise count addition, no re-binning;
+//   * lock-free recording (one ilogb + two relaxed atomic adds), cheap
+//     enough for the per-hop path;
+//   * a small fixed registry (no dynamic allocation, no locks) rendered
+//     by the lighthouse at /metrics (Prometheus) and /status.json, and
+//     snapshot through the C ABI (tft_lathist_snapshot) so worker
+//     processes surface their dp.* distributions through Python telemetry.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <sstream>
+#include <string>
+
+namespace tft {
+namespace lathist {
+
+// steady-clock nanoseconds for the recording sites (now_ms() is too
+// coarse for sub-millisecond hops)
+inline int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Bucket i counts observations in (2^(i-21), 2^(i-20)] seconds; the last
+// slot is the overflow (> 2^6 s). 27 finite bounds: 2^-20 .. 2^6.
+constexpr int kNumBounds = 27;
+constexpr int kMinExp = -20;  // bound[0] = 2^-20 s (~1 us)
+
+inline double bound_s(int i) { return std::ldexp(1.0, kMinExp + i); }
+
+inline int bucket_index(double seconds) {
+  if (!(seconds > 0)) return 0;
+  // ilogb(2^-20) == -20 exactly; values in (2^(e), 2^(e+1)) report e, and
+  // an exact power 2^e must land in ITS OWN bucket (le = 2^e is
+  // inclusive), so shift only strictly-greater values up.
+  int e = std::ilogb(seconds);
+  double lo = std::ldexp(1.0, e);
+  int idx = e - kMinExp + (seconds > lo ? 1 : 0);
+  if (idx < 0) return 0;
+  if (idx > kNumBounds) return kNumBounds;  // overflow slot
+  return idx;
+}
+
+struct Hist {
+  std::atomic<uint64_t> counts[kNumBounds + 1];
+  std::atomic<uint64_t> sum_ns{0};
+  std::atomic<uint64_t> count{0};
+
+  void observe_s(double seconds) {
+    if (seconds < 0) seconds = 0;
+    counts[bucket_index(seconds)].fetch_add(1, std::memory_order_relaxed);
+    sum_ns.fetch_add((uint64_t)(seconds * 1e9), std::memory_order_relaxed);
+    count.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  void reset() {
+    for (auto& c : counts) c.store(0, std::memory_order_relaxed);
+    sum_ns.store(0, std::memory_order_relaxed);
+    count.store(0, std::memory_order_relaxed);
+  }
+};
+
+// The fixed op set. Names are wire-stable: the Python snapshot, the
+// lighthouse render and tests all key on them.
+//   dp.hop         — one stripe ring hop (TCP pump or CMA pull round)
+//   dp.stripe      — one stripe's whole allreduce job (run_stripe)
+//   rpc.serve      — server-side handling of one RPC frame
+//   quorum.fanout  — ManagerSrv's lh.quorum call to the lighthouse
+//                    (the per-step quorum fan-out the HA roadmap item
+//                    needs p50/p99 for)
+enum Op { kDpHop = 0, kDpStripe, kRpcServe, kQuorumFanout, kNumOps };
+
+inline const char* op_name(int op) {
+  switch (op) {
+    case kDpHop: return "dp.hop";
+    case kDpStripe: return "dp.stripe";
+    case kRpcServe: return "rpc.serve";
+    case kQuorumFanout: return "quorum.fanout";
+    default: return "?";
+  }
+}
+
+inline Hist& get(Op op) {
+  static Hist hists[kNumOps];
+  return hists[op];
+}
+
+inline void observe(Op op, double seconds) { get(op).observe_s(seconds); }
+
+inline void reset_all() {
+  for (int i = 0; i < kNumOps; ++i) get((Op)i).reset();
+}
+
+// Interpolated quantile from the cumulative bucket counts (the scrape-side
+// histogram_quantile estimate; 0 when empty).
+inline double quantile(const Hist& h, double q) {
+  uint64_t counts[kNumBounds + 1];
+  uint64_t total = 0;
+  for (int i = 0; i <= kNumBounds; ++i) {
+    counts[i] = h.counts[i].load(std::memory_order_relaxed);
+    total += counts[i];
+  }
+  if (total == 0) return 0.0;
+  double target = q * (double)total;
+  double acc = 0, lo = 0;
+  for (int i = 0; i < kNumBounds; ++i) {
+    double nxt = acc + (double)counts[i];
+    if (nxt >= target && counts[i]) {
+      double frac = (target - acc) / (double)counts[i];
+      if (frac < 0) frac = 0;
+      if (frac > 1) frac = 1;
+      return lo + (bound_s(i) - lo) * frac;
+    }
+    acc = nxt;
+    lo = bound_s(i);
+  }
+  return bound_s(kNumBounds - 1);  // overflow clamps to the last bound
+}
+
+// Prometheus exposition under the native torchft_ prefix (le values are
+// exact powers of two; %.9g renders them round-trip-exact).
+inline void render_prometheus(std::ostringstream& o) {
+  o << "# TYPE torchft_latency_seconds histogram\n";
+  char buf[64];
+  for (int op = 0; op < kNumOps; ++op) {
+    const Hist& h = get((Op)op);
+    uint64_t cum = 0;
+    for (int i = 0; i <= kNumBounds; ++i) {
+      cum += h.counts[i].load(std::memory_order_relaxed);
+      if (i < kNumBounds) {
+        snprintf(buf, sizeof buf, "%.9g", bound_s(i));
+        o << "torchft_latency_seconds_bucket{op=\"" << op_name(op)
+          << "\",le=\"" << buf << "\"} " << cum << "\n";
+      } else {
+        o << "torchft_latency_seconds_bucket{op=\"" << op_name(op)
+          << "\",le=\"+Inf\"} " << cum << "\n";
+      }
+    }
+    snprintf(buf, sizeof buf, "%.9g",
+             (double)h.sum_ns.load(std::memory_order_relaxed) / 1e9);
+    o << "torchft_latency_seconds_sum{op=\"" << op_name(op) << "\"} " << buf
+      << "\n"
+      << "torchft_latency_seconds_count{op=\"" << op_name(op) << "\"} "
+      << h.count.load(std::memory_order_relaxed) << "\n";
+  }
+}
+
+// Compact JSON for /status.json: raw (non-cumulative) per-bucket counts so
+// a consumer can merge across processes exactly, plus p50/p99 convenience.
+inline void render_json(std::ostringstream& o) {
+  char buf[64];
+  o << "{";
+  for (int op = 0; op < kNumOps; ++op) {
+    const Hist& h = get((Op)op);
+    if (op) o << ",";
+    o << "\"" << op_name(op) << "\":{\"counts\":[";
+    for (int i = 0; i <= kNumBounds; ++i) {
+      if (i) o << ",";
+      o << h.counts[i].load(std::memory_order_relaxed);
+    }
+    snprintf(buf, sizeof buf, "%.9g",
+             (double)h.sum_ns.load(std::memory_order_relaxed) / 1e9);
+    o << "],\"count\":" << h.count.load(std::memory_order_relaxed)
+      << ",\"sum_s\":" << buf;
+    snprintf(buf, sizeof buf, "%.9g", quantile(h, 0.5));
+    o << ",\"p50_s\":" << buf;
+    snprintf(buf, sizeof buf, "%.9g", quantile(h, 0.99));
+    o << ",\"p99_s\":" << buf << "}";
+  }
+  o << "}";
+}
+
+}  // namespace lathist
+}  // namespace tft

@@ -15,6 +15,7 @@
 #include <random>
 
 #include "faultinject.h"  // env-gated injection points (torn frames, delays)
+#include "lathist.h"      // rpc.serve latency histogram
 
 namespace tft {
 
@@ -357,6 +358,11 @@ void RpcServer::serve_conn(int fd) {
     if (!read_exact(fd, payload.data(), len, 0)) return;
 
     Value resp = Value::M();
+    // rpc.serve distribution: dispatch + handler time, error paths
+    // included (socket reads excluded; a long-poll quorum wait is part
+    // of the handler by design and shows up here — the serve tail IS
+    // the control plane's latency story)
+    int64_t serve_t0 = lathist::now_ns();
     try {
       Value req = decode(payload);
       std::string method = req.gets("_m");
@@ -380,6 +386,8 @@ void RpcServer::serve_conn(int fd) {
       resp.set("_s", Value::I(INTERNAL));
       resp.set("_e", Value::S(e.what()));
     }
+    lathist::observe(lathist::kRpcServe,
+                     (double)(lathist::now_ns() - serve_t0) / 1e9);
     std::string body = encode(resp);
     uint8_t out[4] = {(uint8_t)(body.size() & 0xff),
                       (uint8_t)((body.size() >> 8) & 0xff),

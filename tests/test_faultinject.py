@@ -404,15 +404,26 @@ class TestFaultMatrix:
     runnable as `python -m torchft_tpu.faultinject.runner`)."""
 
     @pytest.mark.parametrize(
-        "name", ["torn_cma_pull", "kill_allreduce_cma", "ckpt_serve_death"]
+        "name",
+        [
+            "torn_cma_pull", "kill_allreduce_cma", "ckpt_serve_death",
+            "straggler_group",
+        ],
     )
     def test_scenario(self, tmp_path, name):
         from torchft_tpu.faultinject import runner
 
         scn = {s.name: s for s in runner.SCENARIOS}[name]
-        res = runner.run_scenario(
-            scn, str(tmp_path / name), steps=10, timeout_s=420
-        )
+        if name == "straggler_group":
+            # custom two-leg runner: injected skew + control soak, with
+            # the fleet straggler detector hosted by this process
+            res = runner.run_straggler_scenario(
+                scn, str(tmp_path / name), steps=12, timeout_s=420
+            )
+        else:
+            res = runner.run_scenario(
+                scn, str(tmp_path / name), steps=10, timeout_s=420
+            )
         if res.status == "environmental":
             pytest.skip(f"documented environmental corruption: {res.detail}")
         assert res.status == "passed", res

@@ -41,7 +41,8 @@ _TIMEOUT_CODES = (CANCELLED, DEADLINE_EXCEEDED)
 # native `tft_abi_version()`. v2: tft_dp_allreduce's wire_bf16 int became
 # the DpCodec enum — calling an old build with codec=2 would silently run
 # the bf16 wire, so a mismatch forces a rebuild instead of proceeding.
-_ABI_VERSION = 2
+# v3: tft_lathist_snapshot/tft_lathist_reset (native latency histograms).
+_ABI_VERSION = 3
 
 
 def _build(force: bool = False) -> None:
@@ -187,6 +188,14 @@ def _load() -> ctypes.CDLL:
     lib.tft_client_call.restype = c.c_int64
     lib.tft_client_free.argtypes = [c.c_int64]
     lib.tft_client_free.restype = None
+
+    # native latency histograms (native/lathist.h)
+    lib.tft_lathist_snapshot.argtypes = [
+        c.POINTER(u8p), c.POINTER(c.c_int64), c.c_char_p, c.c_int,
+    ]
+    lib.tft_lathist_snapshot.restype = c.c_int64
+    lib.tft_lathist_reset.argtypes = []
+    lib.tft_lathist_reset.restype = None
 
     lib.tft_quorum_compute.argtypes = [
         u8p, c.c_int64, c.POINTER(u8p), c.POINTER(c.c_int64), c.c_char_p, c.c_int,
@@ -386,6 +395,37 @@ def compute_quorum_results(
         _lib.tft_compute_quorum_results, wire.encode(quorum),
         replica_id.encode(), rank,
     )
+
+
+# Fixed log2 bucket grid of the native latency histograms, in seconds —
+# MUST mirror native/lathist.h (kMinExp=-20, kNumBounds=27): one bucket
+# per binary order of magnitude from ~1 µs to 64 s plus an overflow slot.
+# Shared with telemetry.anatomy.LOG2_BUCKETS so Python- and native-side
+# distributions live on one grid and cross-process merges are exact.
+LATHIST_BOUNDS_S = tuple(2.0 ** e for e in range(-20, 7))
+
+
+def lathist_snapshot() -> Dict[str, Dict[str, Any]]:
+    """Snapshot this process's native latency histograms (dp.hop,
+    dp.stripe, rpc.serve, quorum.fanout) as
+    ``{op: {"counts": [int x 28], "count": int, "sum_ns": int}}``.
+    ``counts`` are RAW per-bucket tallies on the fixed
+    :data:`LATHIST_BOUNDS_S` grid (last slot = overflow), so merging two
+    processes' snapshots is exact elementwise addition."""
+    outp = ctypes.POINTER(ctypes.c_uint8)()
+    outlen = ctypes.c_int64()
+    err = _errbuf()
+    code = _lib.tft_lathist_snapshot(
+        ctypes.byref(outp), ctypes.byref(outlen), err, _ERRLEN
+    )
+    if code != OK:
+        _raise_status(code, err.value.decode())
+    return wire.decode(_take_out(outp, outlen))
+
+
+def lathist_reset() -> None:
+    """Zero every native latency histogram (tests/bench interval resets)."""
+    _lib.tft_lathist_reset()
 
 
 class _iovec(ctypes.Structure):

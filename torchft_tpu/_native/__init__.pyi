@@ -46,6 +46,10 @@ def manager_create(
 def manager_shutdown(h: int) -> None: ...
 def store_create(bind: str) -> Tuple[int, str]: ...
 def store_shutdown(h: int) -> None: ...
+LATHIST_BOUNDS_S: Tuple[float, ...]
+
+def lathist_snapshot() -> Dict[str, Dict[str, Any]]: ...
+def lathist_reset() -> None: ...
 def quorum_compute(state: Dict[str, Any]) -> Dict[str, Any]: ...
 def compute_quorum_results(
     quorum: Dict[str, Any], replica_id: str, rank: int

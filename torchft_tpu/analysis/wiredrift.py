@@ -37,6 +37,11 @@ Checks (rule ids):
     invisible to operators, a documented-but-unparsed knob silently
     no-ops in deploy configs.
 
+``obs-env-drift``
+    Same contract for the step-anatomy/SLO/straggler knob families
+    (``TORCHFT_SLO_*`` / ``TORCHFT_STRAGGLER_*``) against the knob
+    registry in ``docs/observability.md``.
+
 ``fault-site-drift``
     Native evidence-record site labels (``fi::write_evidence`` /
     ``fi::kill_self`` call sites) vs ``faultinject.core.NATIVE_SITES``:
@@ -266,6 +271,35 @@ def check_wire_env(
     return finds
 
 
+_OBS_RE = re.compile(r"TORCHFT_(?:SLO|STRAGGLER)_[A-Z0-9_]+")
+
+
+def check_obs_env(
+    py_texts: Dict[str, str], obs_doc_text: str
+) -> List[Finding]:
+    """The TORCHFT_SLO_* / TORCHFT_STRAGGLER_* knob families vs the
+    docs/observability.md knob registry, both directions (the
+    wire-env-drift contract for the step-anatomy plane)."""
+    py: Set[str] = set()
+    for text in py_texts.values():
+        py.update(_OBS_RE.findall(text))
+    doc = set(_OBS_RE.findall(obs_doc_text))
+    finds: List[Finding] = []
+    for k in sorted(py - doc):
+        finds.append(Finding(
+            "obs-env-drift", "docs/observability.md", 0, k,
+            "SLO/straggler knob referenced in code but missing from the "
+            "docs/observability.md knob registry — invisible to operators",
+        ))
+    for k in sorted(doc - py):
+        finds.append(Finding(
+            "obs-env-drift", "docs/observability.md", 0, k,
+            "documented SLO/straggler knob that no code reads — a deploy "
+            "config setting it silently no-ops",
+        ))
+    return finds
+
+
 def check_fault_sites(
     native_texts: Dict[str, str], native_sites: tuple
 ) -> List[Finding]:
@@ -359,6 +393,13 @@ def run(root: Optional[str] = None) -> List[Finding]:
     out += check_rpc_methods(native_texts, py_rpc)
     out += check_fi_env(native_texts, doc, py_fi)
     out += check_wire_env(py_fi, wire_doc)
+    obs_doc_path = os.path.join(root, "docs", "observability.md")
+    obs_doc = (
+        _read(root, "docs/observability.md")
+        if os.path.exists(obs_doc_path)
+        else ""
+    )
+    out += check_obs_env(py_fi, obs_doc)
     out += check_fault_sites(native_texts, NATIVE_SITES)
     out += check_stub(native_init, pyi)
     return out

@@ -66,33 +66,31 @@ __all__ = [
 # The crossgroup bench reads these to attribute its gb_per_sec deltas to a
 # stage instead of reporting an unexplained total (the old
 # pipelined_bf16_wire row's 8.4%-only delta was exactly such a mystery).
+# Since ISSUE 8 both functions are thin shims over the step-anatomy
+# ledger (telemetry/anatomy.py) — ONE source of truth, so the crossgroup
+# stages_per_round_s and the bench step_anatomy row can never drift apart
+# (the shim's old private accumulator dict is gone). The ledger mirrors
+# every record into tft_wire_stage_seconds_total as before.
 # ---------------------------------------------------------------------------
 
-WIRE_STAGES = ("host_copy", "quantize", "wire", "dequant_reduce")
-_wire_stage_lock = threading.Lock()
-_wire_stage_s: Dict[str, float] = {}
+from torchft_tpu.telemetry.anatomy import (  # noqa: E402
+    LEDGER as _ANATOMY_LEDGER,
+    WIRE_STAGES,
+)
 
 
 def record_wire_stage(stage: str, seconds: float) -> None:
-    """Accumulate wall-clock into a wire-plane stage bucket (also mirrored
-    to the ``tft_wire_stage_seconds_total`` metric family)."""
-    if seconds <= 0.0:
-        return
-    with _wire_stage_lock:
-        _wire_stage_s[stage] = _wire_stage_s.get(stage, 0.0) + seconds
-    from torchft_tpu import telemetry
-
-    telemetry.WIRE_STAGE_SECONDS.labels(stage=stage).inc(seconds)
+    """Accumulate wall-clock into a wire-plane stage bucket — a shim over
+    ``telemetry.anatomy.LEDGER.record(..., wire_total=True)``; main-thread
+    records additionally join the current step-anatomy row."""
+    _ANATOMY_LEDGER.record(stage, seconds, wire_total=True)
 
 
 def wire_stage_snapshot(reset: bool = False) -> Dict[str, float]:
-    """Process-cumulative seconds per wire-plane stage; ``reset`` zeroes
-    the local accumulators (the telemetry counters stay monotonic)."""
-    with _wire_stage_lock:
-        out = dict(_wire_stage_s)
-        if reset:
-            _wire_stage_s.clear()
-    return out
+    """Process-cumulative seconds per wire-plane stage; ``reset`` moves
+    the snapshot mark (the ledger's totals and the telemetry counters
+    stay monotonic)."""
+    return _ANATOMY_LEDGER.wire_stage_snapshot(reset)
 
 
 class PeerGoneError(ConnectionError):

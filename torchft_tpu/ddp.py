@@ -284,12 +284,24 @@ def allreduce_gradients(
         for ordinal, idxs in enumerate(plan)
     ]
 
-    # collect averaged pieces per item (in order; waits overlap the tail)
+    # collect averaged pieces per item (in order; waits overlap the tail).
+    # The blocked time is the step's main-thread cost of the cross-group
+    # wire — recorded as the anatomy ledger's `wire` phase (NOT via
+    # record_wire_stage: that would double it into the op-thread socket
+    # totals the crossgroup bench attributes stages with). In a
+    # synchronous fleet a slow peer inflates exactly this wait, which is
+    # what lets the straggler detector's local-time signal exclude it.
+    import time as _time
+
+    from torchft_tpu.telemetry.anatomy import LEDGER as _ledger
+
     item_out: List[np.ndarray] = [None] * len(items)  # type: ignore[list-item]
+    t_wait = _time.perf_counter()
     for idxs, fut in bucket_futs:
         parts = fut.wait()
         for i, piece in zip(idxs, parts):
             item_out[i] = piece
+    _ledger.record("wire", _time.perf_counter() - t_wait)
 
     # reassemble leaves
     out: List[Any] = [None] * len(leaves)

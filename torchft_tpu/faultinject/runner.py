@@ -175,6 +175,31 @@ SCENARIOS: List[Scenario] = [
         quick=False,
     ),
     Scenario(
+        name="straggler_group",
+        description="+200ms skew injected into group 1's collective "
+        "submissions (collective.issue delay); the fleet straggler "
+        "detector (local-step p50s piggybacked to the lighthouse, "
+        "leave-one-out fleet median baseline) must latch exactly that "
+        "group within K fresh observations and emit exactly one latched "
+        "straggler_detected event, a no-injection control soak of equal "
+        "length must produce zero false positives, and checksums must "
+        "stay bit-identical through the skew (custom runner: "
+        "run_straggler_scenario)",
+        victim_schedule={
+            "seed": 4,
+            "rules": [
+                {
+                    "site": "collective.issue",
+                    "match": "allreduce",
+                    "every": 1,
+                    "action": "delay",
+                    "ms": 200,
+                }
+            ],
+        },
+        quick=False,
+    ),
+    Scenario(
         name="ckpt_serve_death",
         description="victim killed mid-run; the survivor's first "
         "checkpoint serve to the healer is cut mid-stream (serve death "
@@ -418,6 +443,153 @@ def run_scenario(scn: Scenario, workdir: str, steps: int = 16,
     )
 
 
+def _final_checksums(workdir: str) -> "tuple[Optional[str], List[str]]":
+    """Collect each group's final param_checksum; returns (error, sums) —
+    error is a human-readable failure reason or None."""
+    sums: List[str] = []
+    for gid in (0, 1):
+        text = _read_log(workdir, gid)
+        m = re.findall(r"param_checksum=(-?[\d.]+|nan|inf)", text)
+        if not m:
+            return (
+                f"g{gid} printed no param_checksum; log tail: {text[-800:]}",
+                sums,
+            )
+        sums.append(m[-1])
+    if any(s in ("nan", "inf") for s in sums):
+        return (f"non-finite committed checksums {sums}", sums)
+    if sums[0] != sums[1]:
+        return (f"checksum divergence across groups: {sums}", sums)
+    return (None, sums)
+
+
+def run_straggler_scenario(
+    scn: Scenario, workdir: str, steps: int = 16, timeout_s: float = 600.0,
+) -> Result:
+    """The straggler_group scenario (ISSUE 8 satellite): two legs.
+
+    **Injected leg** — group 1 submits every allreduce 200 ms late (the
+    ``collective.issue`` delay site). The runner hosts the fleet
+    detector: a :class:`~torchft_tpu.telemetry.slo.FleetMonitor` polls
+    the lighthouse's ``/cluster.json`` for the piggybacked local-step
+    p50s and feeds a :class:`StragglerDetector` (factor 2.0, K=3 — tight
+    enough to latch within the 16-step run, wide enough that scheduler
+    jitter between two identical groups can't reach it). Asserts: the
+    detector names exactly ``train_bytes_1``, emits exactly ONE latched
+    ``straggler_detected`` event, and the final checksums are finite and
+    bit-identical across groups (a delay must never corrupt averages).
+
+    **Control leg** — the identical soak with no injection; the same
+    detector configuration must produce ZERO events (the false-positive
+    gate the ROADMAP elastic-fleet item needs before staleness-bounded
+    async commits can trust the signal).
+    """
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.telemetry.slo import FleetMonitor, StragglerDetector
+
+    victim_id = "train_bytes_1"
+    detector_cfg = dict(factor=2.0, k=3)
+
+    def leg(name: str, inject: bool) -> "tuple[Optional[str], List[Dict], int]":
+        """Run one 2-group soak; returns (error, detector_events, fired)."""
+        wd = os.path.join(workdir, name)
+        os.makedirs(wd, exist_ok=True)
+        evidence_dir = os.path.join(wd, "evidence")
+        os.makedirs(evidence_dir, exist_ok=True)
+        with open(os.path.join(wd, "corpus.bin"), "wb") as f:
+            f.write(bytes(range(256)) * 24)
+        lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+        addr = lighthouse.address().split("//", 1)[-1]
+        monitor = FleetMonitor(
+            lighthouse.address(),
+            detector=StragglerDetector(**detector_cfg),
+            poll_s=0.25,
+        )
+        events: List[Dict] = []
+        env0 = _worker_env(scn, 0)
+        env1 = _worker_env(scn, 1)
+        if not inject:
+            env1.pop("TORCHFT_FAULT_SCHEDULE", None)
+        procs = {
+            0: _spawn(0, addr, wd, steps, env0),
+            1: _spawn(1, addr, wd, steps, env1),
+        }
+        deadline = time.monotonic() + timeout_s
+        err: Optional[str] = None
+        try:
+            while True:
+                # the runner IS the fleet monitor: poll synchronously so
+                # the detection sequence is deterministic per leg
+                try:
+                    events.extend(monitor.poll_once())
+                except Exception:  # noqa: BLE001 — scrape races are fine
+                    pass
+                done = {g: p.poll() for g, p in procs.items()}
+                for gid, rc in done.items():
+                    if rc is not None and rc != 0:
+                        err = (
+                            f"{name}: g{gid} rc={rc}; log tail: "
+                            f"{_read_log(wd, gid)[-1000:]}"
+                        )
+                        break
+                if err or all(rc is not None for rc in done.values()):
+                    break
+                if time.monotonic() > deadline:
+                    err = f"{name}: timeout after {timeout_s}s"
+                    break
+                time.sleep(0.25)
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+            lighthouse.shutdown()
+        if err is None:
+            cs_err, _sums = _final_checksums(wd)
+            if cs_err:
+                err = f"{name}: {cs_err}"
+        return err, events, len(read_evidence(evidence_dir))
+
+    err, events, fired = leg("injected", inject=True)
+    if err:
+        return Result(scn.name, "failed", err, fired=fired)
+    detected = [e for e in events if e["event"] == "straggler_detected"]
+    if len(detected) != 1:
+        return Result(
+            scn.name, "failed",
+            f"expected exactly one latched straggler_detected, got "
+            f"{len(detected)}: {detected}", fired=fired,
+        )
+    # the Manager appends a uuid4 suffix to every replica_id, so match on
+    # the stable example-chosen prefix (2 groups: train_bytes_0 / _1)
+    if not detected[0]["group"].startswith(victim_id):
+        return Result(
+            scn.name, "failed",
+            f"detector named {detected[0]['group']!r}, not the skewed "
+            f"group {victim_id!r}* ({detected[0]})", fired=fired,
+        )
+    if fired == 0:
+        return Result(
+            scn.name, "failed",
+            "no injection evidence recorded — the delay never fired",
+        )
+
+    ctl_err, ctl_events, _ = leg("control", inject=False)
+    if ctl_err:
+        return Result(scn.name, "failed", ctl_err, fired=fired)
+    if ctl_events:
+        return Result(
+            scn.name, "failed",
+            f"control soak emitted detector events (false positives): "
+            f"{ctl_events}", fired=fired,
+        )
+    return Result(
+        scn.name, "passed",
+        f"latched {victim_id} once (p50 {detected[0]['p50_s']}s vs "
+        f"baseline {detected[0]['baseline_s']}s); control soak clean",
+        fired=fired,
+    )
+
+
 # ---------------------------------------------------------------------------
 # sanitizer mode
 # ---------------------------------------------------------------------------
@@ -565,8 +737,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         shutil.rmtree(wd, ignore_errors=True)
         print(f"--- {scn.name}: {scn.description}")
         t0 = time.monotonic()
-        res = run_scenario(scn, wd, steps=steps, timeout_s=args.timeout,
-                           extra_env=extra_env, worker_argv=worker_argv)
+        if scn.name == "straggler_group":
+            if args.sanitize:
+                # the custom runner spawns plain jax workers and does not
+                # thread the sanitizer env/argv — claiming a sanitized
+                # PASS here would be a lie, so refuse loudly
+                ap.error(
+                    "straggler_group is not wired for --sanitize (the "
+                    "detection loop needs the jax trainer's anatomy "
+                    "piggyback); run it unsanitized"
+                )
+            # custom two-leg runner (injected + control soak) with the
+            # fleet detector hosted by the runner process itself
+            res = run_straggler_scenario(
+                scn, wd, steps=steps, timeout_s=args.timeout
+            )
+        else:
+            res = run_scenario(scn, wd, steps=steps, timeout_s=args.timeout,
+                               extra_env=extra_env, worker_argv=worker_argv)
         res_s = time.monotonic() - t0
         print(
             f"    {res.status.upper()} in {res_s:.1f}s "

@@ -334,6 +334,33 @@ class Manager:
         # should_commit ticks it, so its outlier durations are the
         # recorded per-step recovery cost (telemetry step_outlier events)
         self.step_timer = StepTimer()
+        # step-anatomy ledger (ISSUE 8): _finish_commit ticks the process
+        # ledger so every step's wall clock is decomposed into phases;
+        # attaching the timer exports its tagged-outlier digest through
+        # anatomy summaries and the flight-recorder dumps
+        telemetry.LEDGER.attach_timer(self.step_timer)
+        # burn-rate SLO evaluators (telemetry/slo.py; env-gated — zero
+        # cost unless TORCHFT_SLO_STEP_S / TORCHFT_SLO_REJOIN_S are set);
+        # the latch rides the telemetry piggyback to the lighthouse
+        from torchft_tpu.telemetry.slo import SloManager
+
+        self._slo = SloManager()
+        # unguarded-ok: quorum-thread handoff — set at heal begin on the
+        #   quorum thread, consumed at the next committed _finish_commit
+        #   on the main thread (wait_quorum is the barrier)
+        self._rejoin_t0: Optional[float] = None
+        # opt-in fleet straggler monitor: any Manager that knows the
+        # lighthouse address can host the detector (one per fleet is
+        # enough; the faultmatrix runner runs its own)
+        self._fleet_monitor = None
+        if (
+            os.environ.get("TORCHFT_STRAGGLER_MONITOR", "0") == "1"
+            and self._lighthouse_addr is not None
+            and self._rank == 0
+        ):
+            from torchft_tpu.telemetry.slo import FleetMonitor
+
+            self._fleet_monitor = FleetMonitor(self._lighthouse_addr).start()
 
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
@@ -409,6 +436,19 @@ class Manager:
                 "summary": _json.dumps(
                     telemetry.summary(), separators=(",", ":"), default=str
                 ),
+                # step-anatomy digest + the two detector scalars (ISSUE 8):
+                # the lighthouse stores the digest verbatim (spliced into
+                # /cluster.json like the summary) and serves the scalars to
+                # the fleet straggler detector / dashboard SLO column
+                "anatomy": _json.dumps(
+                    telemetry.LEDGER.summary(),
+                    separators=(",", ":"),
+                    default=str,
+                ),
+                "local_step_p50_s": float(
+                    telemetry.LEDGER.local_p50() or 0.0
+                ),
+                "slo_breach": bool(self._slo.breached()),
                 "step": self._step,
                 "stuck": bool(self._watchdog.stalled),
                 "last_heal_ts": float(self._last_heal_ts),
@@ -427,6 +467,8 @@ class Manager:
         """Shut down the manager, checkpoint transport and data plane."""
         self._shutting_down = True
         self._watchdog.stop()
+        if self._fleet_monitor is not None:
+            self._fleet_monitor.stop()
         # unblock any quorum thread parked on the speculation fence (its
         # heal serve will fail downstream, which is fine at shutdown)
         with self._spec_cond:
@@ -549,7 +591,24 @@ class Manager:
         assert (
             self._quorum_future is not None
         ), "must call start_quorum before wait_quorum"
-        self._quorum_future.result()
+        if self._quorum_future.done():
+            self._quorum_future.result()
+            return
+        # step-anatomy: the time the MAIN thread actually blocked on the
+        # quorum (the RPC itself overlaps compute in async mode — only
+        # the tail the trainer had to wait out is step cost). Peer skew
+        # lands here too: the lighthouse's long-poll waits for the whole
+        # fleet, so a straggler stretches every OTHER group's quorum_wait
+        # — which is exactly why the local-time signal excludes it.
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            self._quorum_future.result()
+        finally:
+            telemetry.LEDGER.record(
+                "quorum_wait", _time.perf_counter() - t0
+            )
 
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: timedelta
@@ -699,6 +758,10 @@ class Manager:
             if quorum.heal:
                 self._healing = True
                 t_heal = _time.perf_counter()
+                # rejoin-to-commit SLO clock starts at heal begin; the
+                # first committed _finish_commit on the main thread
+                # observes and clears it
+                self._rejoin_t0 = t_heal
                 telemetry.emit(
                     "heal_begin",
                     step=quorum.max_step,
@@ -825,7 +888,14 @@ class Manager:
         assert self._pending_state_dict is not None, "checkpoint was not staged"
         assert self._load_state_dict is not None, "user load_state_dict not set"
         self._logger.info("applying pending state dict")
+        import time as _time
+
+        t0 = _time.perf_counter()
         self._load_state_dict(cast(T, self._pending_state_dict["user"]))
+        # step-anatomy `heal` phase: the main-thread share of a heal (the
+        # staged-state apply; the transfer itself rides the quorum thread
+        # and shows as quorum_wait — docs/observability.md "Step anatomy")
+        telemetry.LEDGER.record("heal", _time.perf_counter() - t0)
         self._pending_state_dict = None
 
     # ------------------------------------------------------------------
@@ -1280,6 +1350,18 @@ class Manager:
                 tags=list(self.step_timer.last_tags),
                 committed=should_commit,
             )
+        # step-anatomy boundary: the barrier cost joins this step's row,
+        # then the row is assembled (idle = wall minus attributed phases)
+        # and the SLO evaluators see the step's wall/rejoin durations
+        telemetry.LEDGER.record("commit_barrier", barrier_s)
+        row = telemetry.LEDGER.tick(step=step_in_trail)
+        if row is not None:
+            self._slo.observe_step(row["wall_s"])
+        if should_commit and self._rejoin_t0 is not None:
+            import time as _time
+
+            self._slo.observe_rejoin(_time.perf_counter() - self._rejoin_t0)
+            self._rejoin_t0 = None
 
     def should_commit(self, timeout: Optional[timedelta] = None) -> bool:
         """Per-step commit barrier: True iff every rank in the group had a

@@ -95,15 +95,40 @@ class TrainStep:
 
     # -- drive --
 
+    @staticmethod
+    def _record_compute(t0: float) -> None:
+        # step-anatomy `compute` phase: main-thread time inside the jitted
+        # calls (dispatch + any blocking; with async dispatch the device
+        # tail lands in whoever blocks next — usually the host copy, which
+        # the ledger attributes to host_copy/wire). Best-effort.
+        import time as _time
+
+        try:
+            from torchft_tpu.telemetry.anatomy import LEDGER
+
+            LEDGER.record("compute", _time.perf_counter() - t0)
+        except Exception:  # noqa: BLE001 — observability never fails a step
+            pass
+
     def step(self, params, opt_state, tokens) -> Tuple[jnp.ndarray, Any, Any]:
         """Fused grads+update (single replica group / no FT averaging)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         with jax.set_mesh(self.mesh):
-            return self._fused(params, opt_state, tokens)
+            out = self._fused(params, opt_state, tokens)
+        self._record_compute(t0)
+        return out
 
     def grads(self, params, tokens) -> Tuple[jnp.ndarray, Any]:
         """Loss + gradient pytree (still on device)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         with jax.set_mesh(self.mesh):
-            return self._value_and_grad(params, tokens)
+            out = self._value_and_grad(params, tokens)
+        self._record_compute(t0)
+        return out
 
     def apply(self, params, opt_state, grads, donate: bool = True) -> Tuple[Any, Any]:
         """Apply (possibly host-averaged) grads.
@@ -111,9 +136,15 @@ class TrainStep:
         ``donate=False`` keeps the input buffers alive (at the cost of the
         update not being in-place) — required when the caller retains the
         pre-update trees as a pipelined-commit rollback snapshot."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         with jax.set_mesh(self.mesh):
             if donate:
-                return self._apply(params, opt_state, grads)
-            if self._apply_keep is None:
-                self._apply_keep = jax.jit(self._apply_updates_fn)
-            return self._apply_keep(params, opt_state, grads)
+                out = self._apply(params, opt_state, grads)
+            else:
+                if self._apply_keep is None:
+                    self._apply_keep = jax.jit(self._apply_updates_fn)
+                out = self._apply_keep(params, opt_state, grads)
+        self._record_compute(t0)
+        return out

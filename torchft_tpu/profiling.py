@@ -147,3 +147,14 @@ class StepTimer:
         """Recent outlier steps as (step_index, duration_s, tags) — the
         recorded recovery cost per FT event."""
         return list(self._outliers)
+
+    def outlier_digest(self) -> List[dict]:
+        """JSON-safe form of :meth:`outliers` — exported through the
+        step-anatomy summaries and the flight-recorder/SIGUSR2 dumps
+        (``telemetry.anatomy.LEDGER.attach_timer``), so the tagged
+        recovery costs finally leave the process instead of living and
+        dying in this deque."""
+        return [
+            {"step": s, "duration_s": round(d, 4), "tags": list(tags)}
+            for s, d, tags in self._outliers
+        ]

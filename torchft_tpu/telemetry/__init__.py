@@ -30,6 +30,13 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+from torchft_tpu.telemetry.anatomy import (
+    LEDGER,
+    LOG2_BUCKETS,
+    PHASES,
+    StepLedger,
+    merge_lathist,
+)
 from torchft_tpu.telemetry.events import (
     CANONICAL_EVENTS,
     ENV_TRAIL_PATH,
@@ -56,6 +63,11 @@ __all__ = [
     "EVENTS",
     "TRACER",
     "FLIGHT",
+    "LEDGER",
+    "LOG2_BUCKETS",
+    "PHASES",
+    "StepLedger",
+    "merge_lathist",
     "Counter",
     "Gauge",
     "Histogram",
@@ -239,6 +251,46 @@ FAULTS_INJECTED = REGISTRY.counter(
     labelnames=("site", "action"),
 )
 
+# step-anatomy ledger (telemetry/anatomy.py): per-step wall clock
+# decomposed into named phases on the fixed log2 bucket grid shared with
+# the native plane's latency histograms (native/lathist.h), so cross-
+# plane/process merges are exact
+STEP_PHASE_SECONDS = REGISTRY.histogram(
+    "tft_step_phase_seconds",
+    "Per-step seconds spent in each anatomy phase (compute / host_copy / "
+    "quantize / wire / dequant_reduce / quorum_wait / commit_barrier / "
+    "heal / idle — docs/observability.md 'Step anatomy')",
+    labelnames=("phase",),
+    buckets=LOG2_BUCKETS,
+)
+STEP_WALL_SECONDS = REGISTRY.histogram(
+    "tft_step_wall_seconds",
+    "Per-step wall clock as the anatomy ledger measures it (tick to tick)",
+    buckets=LOG2_BUCKETS,
+)
+STEP_LOCAL_SECONDS = REGISTRY.histogram(
+    "tft_step_local_seconds",
+    "Per-step LOCAL time: wall minus the peer-wait phases (wire, "
+    "quorum_wait, commit_barrier, heal) — the straggler-discriminating "
+    "signal piggybacked to the lighthouse",
+    buckets=LOG2_BUCKETS,
+)
+
+# SLO / straggler plane (telemetry/slo.py)
+SLO_BREACH_TOTAL = REGISTRY.counter(
+    "tft_slo_breach_total",
+    "Burn-rate SLO breaches latched, by SLO (step_time / rejoin_commit)",
+    labelnames=("slo",),
+)
+STRAGGLER_DETECTED = REGISTRY.counter(
+    "tft_straggler_detected_total",
+    "Straggler latches by the fleet detector, by replica group",
+    labelnames=("group",),
+)
+STRAGGLERS = REGISTRY.gauge(
+    "tft_stragglers", "Replica groups currently latched as stragglers"
+)
+
 # Pre-create the CLOSED label sets so their series exist (zero-valued)
 # from process start: dashboards and absent-series alerts can then tell
 # "healthy, zero heals" from "trainer not scraped". Open-ended label sets
@@ -255,7 +307,11 @@ for _reason in ("signal", "deadline", "watchdog", "manual"):
     FLIGHT_DUMPS.labels(reason=_reason)
 for _stage in ("host_copy", "quantize", "wire", "dequant_reduce"):
     WIRE_STAGE_SECONDS.labels(stage=_stage)
-del _role, _outcome, _kind, _result, _reason, _stage
+for _phase in PHASES:
+    STEP_PHASE_SECONDS.labels(phase=_phase)
+for _slo in ("step_time", "rejoin_commit"):
+    SLO_BREACH_TOTAL.labels(slo=_slo)
+del _role, _outcome, _kind, _result, _reason, _stage, _phase, _slo
 
 
 # ---------------------------------------------------------------------------
@@ -375,8 +431,9 @@ def summary() -> Dict[str, Any]:
 
 def reset() -> None:
     """Zero every metric in place and empty the event/span/flight rings
-    (tests)."""
+    and the step-anatomy ledger (tests)."""
     REGISTRY.reset_values()
     EVENTS.clear()
     TRACER.clear()
     FLIGHT.clear()
+    LEDGER.reset()

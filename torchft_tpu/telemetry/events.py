@@ -62,6 +62,10 @@ CANONICAL_EVENTS = (
     "watchdog_stall",
     "flight_dump",
     "fault_injected",
+    "slo_breach",
+    "slo_recovered",
+    "straggler_detected",
+    "straggler_cleared",
 )
 
 

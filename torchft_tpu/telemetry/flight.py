@@ -174,6 +174,16 @@ class FlightRecorder:
             "entries": entries,
             **self.analyze(entries),
         }
+        # the step-anatomy ledger rides every dump (ISSUE 8 satellite:
+        # one handler, one evidence dir — the SIGUSR2 / watchdog /
+        # deadline dump now answers "where did the wedged step's time go"
+        # next to "which op is stuck"), tagged-outlier digest included
+        try:
+            from torchft_tpu.telemetry.anatomy import LEDGER
+
+            payload["anatomy"] = LEDGER.dump()
+        except Exception:  # noqa: BLE001 — never fail the dump path
+            pass
         path = os.path.join(
             self.dump_dir(), f"tft_flight_{os.getpid()}_{seq}.json"
         )

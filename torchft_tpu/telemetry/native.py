@@ -22,7 +22,23 @@ __all__ = [
     "scrape_lighthouse_metrics",
     "poll_cluster",
     "fetch_merged_trace",
+    "native_latency_snapshot",
 ]
+
+
+def native_latency_snapshot() -> Optional[Dict[str, Any]]:
+    """THIS process's native latency histograms (dp.hop / dp.stripe /
+    rpc.serve / quorum.fanout) from ``_native.lathist_snapshot``: raw
+    per-bucket counts on the fixed log2 grid shared with
+    ``telemetry.anatomy.LOG2_BUCKETS``. Merge snapshots from several
+    processes with ``telemetry.merge_lathist`` (exact — same bounds
+    everywhere). None when the native plane isn't loaded."""
+    try:
+        from torchft_tpu import _native
+
+        return _native.lathist_snapshot()
+    except Exception:  # noqa: BLE001 — degrade, don't raise
+        return None
 
 
 def _base_url(addr: str) -> str:
