@@ -1,0 +1,384 @@
+// torchft_tpu native core — striped checkpoint blob plane.
+// See blob.h for the protocol and staging contract.
+
+#include "blob.h"
+
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+
+#include "faultinject.h"  // env-gated injection (torn serve, serve kill)
+#include "rpc.h"          // tcp_listen / tcp_connect / listen_port / now_ms
+#include "stripe.h"       // shared stripe framing/socket plumbing
+
+namespace tft {
+
+namespace {
+
+// serve-side request deadline: one range on loopback/DCN completes in
+// well under this; a wedged healer is kicked off its socket by unstage()
+// long before the deadline matters
+constexpr int64_t kServeTimeoutMs = 120000;
+constexpr int64_t kIdleTimeoutMs = 30000;
+
+// process-wide serve counter for the env-gated injection points (same
+// process-stable coordinate scheme as the data plane's hop counters)
+std::atomic<long> g_fi_blob_serves{0};
+
+}  // namespace
+
+BlobServer::BlobServer() {
+  std::string err;
+  listen_fd_ = tcp_listen("[::]:0", &err);
+  if (listen_fd_ < 0) {
+    throw std::runtime_error("blob listen failed: " + err);
+  }
+  port_ = listen_port(listen_fd_);
+  acceptor_ = std::thread([this] { accept_loop(); });
+}
+
+BlobServer::~BlobServer() { shutdown(); }
+
+void BlobServer::shutdown() {
+  bool was = closed_.exchange(true);
+  if (was) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+  }
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    staged_ = false;
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    cv_.notify_all();
+  }
+  if (acceptor_.joinable()) acceptor_.join();
+  for (;;) {
+    std::thread t;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (conn_threads_.empty()) break;
+      auto it = conn_threads_.begin();
+      t = std::move(it->second);
+      conn_threads_.erase(it);
+    }
+    if (t.joinable()) t.join();
+  }
+  listen_fd_ = -1;
+}
+
+void BlobServer::accept_loop() {
+  uint64_t next_id = 0;
+  while (!closed_.load()) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (closed_.load()) return;
+      if (errno == EINTR) continue;
+      return;  // listener closed
+    }
+    stripeio::tune_socket(fd);
+    stripeio::set_nonblock(fd);
+    // reap finished handlers before spawning the next: one-shot range
+    // connections finish fast, so the announced-finished list keeps the
+    // map from growing across many heals (joins here never block long —
+    // a finished id's thread is past its serve loop)
+    std::vector<std::thread> reap;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (closed_.load()) {
+        ::close(fd);
+        return;
+      }
+      for (uint64_t done_id : conn_finished_) {
+        auto it = conn_threads_.find(done_id);
+        if (it != conn_threads_.end()) {
+          reap.push_back(std::move(it->second));
+          conn_threads_.erase(it);
+        }
+      }
+      conn_finished_.clear();
+      uint64_t id = next_id++;
+      conn_fds_.insert(fd);
+      conn_threads_.emplace(
+          id, std::thread([this, fd, id] { serve_conn(fd, id); }));
+    }
+    for (auto& t : reap) {
+      if (t.joinable()) t.join();
+    }
+  }
+}
+
+void BlobServer::serve_conn(int fd, uint64_t id) {
+  for (;;) {
+    BlobReq req{};
+    bool timed_out = false;
+    std::string err;
+    if (!stripeio::recv_all(fd, &req, sizeof(req),
+                            now_ms() + kIdleTimeoutMs, &timed_out, &err) ||
+        req.magic != kBlobMagic) {
+      break;  // client done (EOF), garbage, or idle
+    }
+    if (!serve_one(fd, req, now_ms() + kServeTimeoutMs, &err)) break;
+  }
+  std::lock_guard<std::mutex> g(mu_);
+  conn_fds_.erase(fd);
+  ::close(fd);
+  conn_finished_.push_back(id);  // the accept loop joins us later
+}
+
+bool BlobServer::serve_one(int fd, const BlobReq& req, int64_t deadline_ms,
+                           std::string* err) {
+  // env-gated injection (docs/fault_injection.md): SIGKILL on the nth
+  // range serve this process runs (stripe-serving peer death mid-heal —
+  // the stripe_heal_peer_death scenario), or promise the full length and
+  // cut after a fraction (torn stripe serve; the healer must see a short
+  // read, never short data)
+  static const long fi_kill = fi::parse_long("TORCHFT_FI_BLOB_KILL");
+  static const fi::NthSpec fi_cut = fi::parse_nth("TORCHFT_FI_BLOB_CUT");
+  long fi_h = 0;
+  if (fi_kill > 0 || fi_cut.nth > 0) fi_h = ++g_fi_blob_serves;
+  if (fi_kill > 0 && fi_h == fi_kill) fi::kill_self("blob.serve", fi_h);
+
+  // snapshot the staged layout + verdict under the lock, pin the
+  // buffers with active_serves_ (unstage waits it out before the caller
+  // may free). NO socket IO under mu_: a stalled client would otherwise
+  // hold the mutex against stage()/unstage() — the quorum-critical path
+  // — for up to the serve deadline, and the unstage kick itself needs
+  // mu_ (the blocking-under-lock class the repo's own lint forbids).
+  std::vector<uint64_t> bases;
+  std::vector<int64_t> lens;
+  std::vector<uint64_t> prefix;
+  BlobStatus verdict = BlobStatus::kOk;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!staged_ || req.token != token_) {
+      verdict = BlobStatus::kStale;
+    } else if (req.len == 0 || req.offset > total_ ||
+               req.len > total_ - req.offset) {
+      verdict = BlobStatus::kBadRange;
+    } else {
+      bases = bases_;
+      lens = lens_;
+      prefix = prefix_;
+      ++active_serves_;
+    }
+  }
+  if (verdict != BlobStatus::kOk) {
+    BlobRsp rsp{kBlobMagic, (uint32_t)verdict, 0};
+    bool to = false;
+    return stripeio::send_all(fd, &rsp, sizeof(rsp), deadline_ms, &to, err);
+  }
+
+  bool ok = true;
+  {
+    bool timed_out = false;
+    BlobRsp rsp{kBlobMagic, (uint32_t)BlobStatus::kOk, req.len};
+    ok = stripeio::send_all(fd, &rsp, sizeof(rsp), deadline_ms, &timed_out,
+                            err);
+    // torn-serve budget: full header already sent, cut after frac bytes
+    uint64_t budget = req.len;
+    bool torn = false;
+    if (ok && fi_cut.nth > 0 && fi_h == fi_cut.nth) {
+      budget = (uint64_t)((double)req.len * fi_cut.frac);
+      torn = true;
+      fi::write_evidence("blob.serve", fi_h, "torn");
+    }
+    // walk the scattered buffers overlapping [offset, offset+len)
+    uint64_t off = req.offset;
+    uint64_t remaining = req.len;
+    size_t i = (size_t)(std::upper_bound(prefix.begin(), prefix.end(), off) -
+                        prefix.begin()) - 1;
+    while (ok && remaining > 0 && budget > 0 && i < bases.size()) {
+      uint64_t in_buf = off - prefix[i];
+      uint64_t avail = (uint64_t)lens[i] - in_buf;
+      uint64_t n = std::min(remaining, avail);
+      n = std::min(n, budget);
+      if (n > 0) {
+        ok = stripeio::send_all(fd, (const void*)(uintptr_t)(bases[i] + in_buf),
+                                (size_t)n, deadline_ms, &timed_out, err);
+        off += n;
+        remaining -= n;
+        budget -= n;
+      }
+      if (in_buf + n >= (uint64_t)lens[i]) ++i;
+    }
+    if (torn) {
+      // hard-cut mid-body, exactly like the serving process dying: the
+      // client's recv must fail the range, never accept a short one
+      ::shutdown(fd, SHUT_RDWR);
+      ok = false;
+      *err = "fault injection: torn blob serve";
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    --active_serves_;
+    cv_.notify_all();
+  }
+  return ok;
+}
+
+void BlobServer::stage(const uint64_t* bases, const int64_t* lens, int nbufs,
+                       uint64_t token) {
+  std::unique_lock<std::mutex> g(mu_);
+  // a restage must never swap the layout under an in-flight serve (the
+  // old buffers may be freed the moment this returns): close the window
+  // first, kick live connections, and wait the serves out
+  staged_ = false;
+  for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  cv_.wait(g, [&] { return active_serves_ == 0; });
+  bases_.assign(bases, bases + nbufs);
+  lens_.assign(lens, lens + nbufs);
+  prefix_.resize((size_t)nbufs);
+  uint64_t acc = 0;
+  for (int i = 0; i < nbufs; ++i) {
+    prefix_[(size_t)i] = acc;
+    acc += (uint64_t)lens[i];
+  }
+  total_ = acc;
+  token_ = token;
+  staged_ = true;
+}
+
+void BlobServer::unstage() {
+  std::unique_lock<std::mutex> g(mu_);
+  if (!staged_ && active_serves_ == 0) return;
+  staged_ = false;
+  // in-flight payload sends still read the staged buffers: kick them off
+  // their sockets so the wait below is bounded by a failed send, not by
+  // a slow healer's timeout
+  for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  cv_.wait(g, [&] { return active_serves_ == 0; });
+}
+
+int blob_fetch(const std::string& host, int port, uint64_t token,
+               uint64_t offset, uint64_t len, void* dst, int64_t timeout_ms,
+               std::string* err) {
+  int64_t deadline = now_ms() + timeout_ms;
+  int fd = tcp_connect(host, port, timeout_ms, err);
+  if (fd < 0) return -1;
+  stripeio::tune_socket(fd);
+  stripeio::set_nonblock(fd);
+  bool timed_out = false;
+  int rc = -1;
+  do {
+    BlobReq req{kBlobMagic, 0, token, offset, len};
+    if (!stripeio::send_all(fd, &req, sizeof(req), deadline, &timed_out, err))
+      break;
+    BlobRsp rsp{};
+    if (!stripeio::recv_all(fd, &rsp, sizeof(rsp), deadline, &timed_out, err))
+      break;
+    if (rsp.magic != kBlobMagic) {
+      *err = "blob: bad reply magic";
+      break;
+    }
+    if (rsp.status != (uint32_t)BlobStatus::kOk) {
+      *err = rsp.status == (uint32_t)BlobStatus::kStale
+                 ? "blob: stale token (checkpoint window closed)"
+                 : "blob: bad range";
+      break;
+    }
+    if (rsp.len != len) {
+      *err = "blob: length mismatch";
+      break;
+    }
+    if (!stripeio::recv_all(fd, dst, (size_t)len, deadline, &timed_out, err))
+      break;
+    rc = 0;
+  } while (false);
+  ::close(fd);
+  if (rc != 0 && timed_out) return -2;
+  return rc;
+}
+
+}  // namespace tft
+
+// ---- C ABI for ctypes ------------------------------------------------------
+
+namespace {
+
+std::mutex g_blob_mu;
+int64_t g_blob_next = 1;
+std::map<int64_t, std::shared_ptr<tft::BlobServer>> g_blobs;
+
+std::shared_ptr<tft::BlobServer> blob_get(int64_t h) {
+  std::lock_guard<std::mutex> g(g_blob_mu);
+  auto it = g_blobs.find(h);
+  return it == g_blobs.end() ? nullptr : it->second;
+}
+
+void blob_set_err(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    strncpy(err, msg.c_str(), (size_t)errlen - 1);
+    err[errlen - 1] = '\0';
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t tft_blob_serve_create(char* err, int errlen) {
+  try {
+    auto srv = std::make_shared<tft::BlobServer>();
+    std::lock_guard<std::mutex> g(g_blob_mu);
+    int64_t h = g_blob_next++;
+    g_blobs[h] = std::move(srv);
+    return h;
+  } catch (const std::exception& e) {
+    blob_set_err(err, errlen, e.what());
+    return 0;
+  }
+}
+
+int tft_blob_serve_port(int64_t h) {
+  auto srv = blob_get(h);
+  return srv ? srv->port() : -1;
+}
+
+int tft_blob_stage(int64_t h, const uint64_t* bases, const int64_t* lens,
+                   int nbufs, uint64_t token, char* err, int errlen) {
+  auto srv = blob_get(h);
+  if (!srv) {
+    blob_set_err(err, errlen, "bad handle");
+    return -1;
+  }
+  srv->stage(bases, lens, nbufs, token);
+  return 0;
+}
+
+int tft_blob_unstage(int64_t h) {
+  auto srv = blob_get(h);
+  if (!srv) return -1;
+  srv->unstage();
+  return 0;
+}
+
+void tft_blob_serve_free(int64_t h) {
+  std::shared_ptr<tft::BlobServer> srv;
+  {
+    std::lock_guard<std::mutex> g(g_blob_mu);
+    auto it = g_blobs.find(h);
+    if (it == g_blobs.end()) return;
+    srv = std::move(it->second);
+    g_blobs.erase(it);
+  }
+  srv->shutdown();
+}
+
+int tft_blob_fetch(const char* host, int port, uint64_t token,
+                   uint64_t offset, uint64_t len, void* dst,
+                   int64_t timeout_ms, char* err, int errlen) {
+  std::string e;
+  int rc = tft::blob_fetch(host ? host : "", port, token, offset, len, dst,
+                           timeout_ms, &e);
+  if (rc != 0) blob_set_err(err, errlen, e);
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,124 @@
+// torchft_tpu native core — striped checkpoint blob plane.
+//
+// The checkpoint-transfer sibling of the gradient data plane: a healer
+// pulls byte ranges of the staged (flattened) state tree from every live
+// peer in parallel, GIL-free, over the shared stripe layer (stripe.h).
+// The Python HTTP transport stays the control plane (metadata, stripe
+// plan, differential negotiation); this plane only moves the bulk bytes
+// — one BlobServer per checkpoint transport, staged/unstaged in lockstep
+// with the HTTP serving window so both planes serve the same bytes.
+//
+// Protocol (per request; connections are one-shot per range — the
+// client is a short-lived fetch thread and loopback/DC connection setup
+// is noise next to MB-scale ranges):
+//
+//   client -> BlobReq { magic, token, offset, len }
+//   server -> BlobRsp { magic, status, len } + len payload bytes
+//
+// `token` names the staging generation: a request against a stale or
+// unstaged window is answered with kStale and NO payload, so a healer
+// can never stream bytes from a superseded checkpoint (the torn-state
+// class of bugs the PR 4 ckpt_serve_death scenario guards against).
+#ifndef TFT_BLOB_H_
+#define TFT_BLOB_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace tft {
+
+struct BlobReq {
+  uint32_t magic;
+  uint32_t reserved;
+  uint64_t token;
+  uint64_t offset;
+  uint64_t len;
+};
+
+struct BlobRsp {
+  uint32_t magic;
+  uint32_t status;  // BlobStatus
+  uint64_t len;
+};
+
+enum class BlobStatus : uint32_t {
+  kOk = 0,
+  kStale = 1,     // token does not match the staged generation
+  kBadRange = 2,  // offset/len outside the staged blob
+};
+
+constexpr uint32_t kBlobMagic = 0x7F7A0DB1;  // distinct from dp/ctl hellos
+
+class BlobServer {
+ public:
+  // Listens on an ephemeral port and starts the acceptor. Throws
+  // std::runtime_error on bind failure.
+  BlobServer();
+  ~BlobServer();
+
+  BlobServer(const BlobServer&) = delete;
+  BlobServer& operator=(const BlobServer&) = delete;
+
+  int port() const { return port_; }
+
+  // Stage the logical concatenation of `nbufs` scattered buffers (the
+  // flattened state tree's host arrays — no coalescing copy). The caller
+  // (Python transport) must keep the buffers alive until unstage()
+  // returns. `token` names this staging generation.
+  void stage(const uint64_t* bases, const int64_t* lens, int nbufs,
+             uint64_t token);
+
+  // Close the serving window: mark the generation stale, kick in-flight
+  // serves off their sockets, and return once no serve still reads the
+  // staged buffers (so the caller may free them). Bounded: active
+  // connections are shut down first, so serves fail fast.
+  void unstage();
+
+  void shutdown();
+
+ private:
+  void accept_loop();
+  void serve_conn(int fd, uint64_t id);
+  bool serve_one(int fd, const BlobReq& req, int64_t deadline_ms,
+                 std::string* err);
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::thread acceptor_;
+  std::atomic<bool> closed_{false};
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool staged_ = false;           // guarded-by: mu_
+  uint64_t token_ = 0;            // guarded-by: mu_
+  std::vector<uint64_t> bases_;   // guarded-by: mu_
+  std::vector<int64_t> lens_;     // guarded-by: mu_
+  std::vector<uint64_t> prefix_;  // guarded-by: mu_ (prefix[i] = start of buf i)
+  uint64_t total_ = 0;            // guarded-by: mu_
+  int active_serves_ = 0;         // guarded-by: mu_ (serves inside a payload)
+  std::set<int> conn_fds_;        // guarded-by: mu_ (live connections)
+  // connection handler threads, reaped by the acceptor (same pattern as
+  // the data plane's hello threads: finished handlers announce their id,
+  // the accept loop joins them — a long-lived process serving many heals
+  // must not accumulate joinable thread stacks until shutdown)
+  std::map<uint64_t, std::thread> conn_threads_;  // guarded-by: mu_
+  std::vector<uint64_t> conn_finished_;           // guarded-by: mu_
+};
+
+// Client side: pull `len` bytes at `offset` of the staged blob into
+// `dst`. Returns 0 on success, -1 on failure (mid-stream EOF, stale
+// token, bad range — *err says which), -2 on deadline.
+int blob_fetch(const std::string& host, int port, uint64_t token,
+               uint64_t offset, uint64_t len, void* dst, int64_t timeout_ms,
+               std::string* err);
+
+}  // namespace tft
+
+#endif  // TFT_BLOB_H_

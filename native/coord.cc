@@ -99,6 +99,10 @@ Value ManagerQuorumResult::to_value() const {
   Value ids = Value::L();
   for (const auto& id : participant_ids) ids.list.push_back(Value::S(id));
   v.set("participant_ids", ids);
+  Value srcs = Value::L();
+  for (const auto& a : recover_src_addresses) srcs.list.push_back(Value::S(a));
+  v.set("recover_src_addresses", srcs);
+  v.set("heal_pending", Value::B(heal_pending));
   return v;
 }
 
@@ -306,6 +310,19 @@ ManagerQuorumResult compute_quorum_results(const std::string& replica_id,
   out.replica_rank = replica_rank;
   out.replica_world_size = (int64_t)participants.size();
   for (const auto& p : participants) out.participant_ids.push_back(p.replica_id);
+  // Striped-heal source list: every max-step cohort member holds the
+  // bit-identical committed state, so a healer may pull stripes from all
+  // of them in parallel. EXCEPT at bootstrap — before the first
+  // committed sync the groups' states are merely same-shaped, not
+  // identical, so only the single bootstrap source is sound (the same
+  // reasoning as the bootstrap_src deviation above).
+  out.heal_pending = !all_recover_dst.empty();
+  if (max_step == 0) {
+    out.recover_src_addresses.push_back(bootstrap_src.address);
+  } else {
+    for (size_t i : max_idx)
+      out.recover_src_addresses.push_back(participants[i].address);
+  }
   return out;
 }
 

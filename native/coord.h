@@ -82,6 +82,15 @@ struct ManagerQuorumResult {
   // Quorum members' replica_ids in replica_rank order, so the data plane can
   // map a failed peer's ring rank back to a replica_id for lh.evict reports.
   std::vector<std::string> participant_ids;
+  // Striped multi-source heal (docs/heal_plane.md): manager addresses of
+  // EVERY max-step cohort member (bit-identical committed state, so any
+  // of them can serve any stripe) — except at bootstrap (max_step == 0),
+  // where states are not yet proven identical and only the single
+  // bootstrap source is listed. heal_pending tells up-to-date members
+  // that SOMEONE heals this round, so they all stage a checkpoint even
+  // when the round-robin assigned them no healer of their own.
+  std::vector<std::string> recover_src_addresses;
+  bool heal_pending = false;
 
   Value to_value() const;
 };

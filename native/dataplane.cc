@@ -21,37 +21,26 @@
 #include "faultinject.h"  // env-gated injection points (torn hops, kills)
 #include "lathist.h"      // dp.hop / dp.stripe latency histograms
 #include "rpc.h"  // tcp_listen / tcp_connect / listen_port / now_ms
+#include "stripe.h"  // shared stripe framing/partition (also used by blob.cc)
 
 namespace tft {
+
+// the shared stripe layer owns the framing/socket plumbing both striped
+// planes (allreduce + checkpoint blob) speak — see stripe.h
+using stripeio::err_wouldblock;
+using stripeio::HopHdr;
+using stripeio::set_nonblock;
+using stripeio::tune_socket;
 
 namespace {
 
 constexpr uint32_t kHelloMagic = 0x7F7A0D01;  // distinct from control hello
-constexpr int kSockBuf = 1 << 22;             // 4 MB: loopback throughput
-
-struct HopHdr {
-  uint32_t tag;
-  uint32_t len;
-};
 
 struct CmaDesc {
   uint32_t tag;
   uint32_t len;
   uint64_t addr;
 };
-
-void set_nonblock(int fd) {
-  int fl = fcntl(fd, F_GETFL, 0);
-  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
-}
-
-void tune_socket(int fd) {
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  int buf = kSockBuf;
-  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
-  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
-}
 
 // bf16 round-to-nearest-even, matching numpy/ml_dtypes astype semantics
 // for the values gradients take (the Python wire codec this plane must be
@@ -201,69 +190,11 @@ void reduce_from_int8(float* acc, const uint8_t* wire, size_t n, DpOp op) {
   }
 }
 
-// EAGAIN/EWOULDBLOCK may be the same value (they are on Linux) — the
-// guard keeps the portable double-check without tripping -Wlogical-op
-// in every nonblocking pump
-inline bool err_wouldblock(int e) {
-#if defined(EWOULDBLOCK) && EWOULDBLOCK != EAGAIN
-  if (e == EWOULDBLOCK) return true;
-#endif
-  return e == EAGAIN;
-}
-
-// poll-bounded helpers for the tiny CMA control messages (they always fit
-// the socket buffer, so these loops complete in one or two iterations)
-bool send_small(int fd, const void* buf, size_t n, int64_t deadline_ms,
-                bool* timed_out, std::string* err) {
-  size_t off = 0;
-  while (off < n) {
-    ssize_t k = ::send(fd, (const uint8_t*)buf + off, n - off, MSG_NOSIGNAL);
-    if (k > 0) {
-      off += (size_t)k;
-      continue;
-    }
-    if (k < 0 && err_wouldblock(errno)) {
-      int64_t left = deadline_ms - now_ms();
-      if (left <= 0) {
-        *timed_out = true;
-        *err = "send deadline exceeded";
-        return false;
-      }
-      pollfd pfd{fd, POLLOUT, 0};
-      ::poll(&pfd, 1, (int)(left > 200 ? 200 : left));
-      continue;
-    }
-    *err = std::string("send: ") + (k == 0 ? "closed" : errno_str(errno));
-    return false;
-  }
-  return true;
-}
-
-bool recv_small(int fd, void* buf, size_t n, int64_t deadline_ms,
-                bool* timed_out, std::string* err) {
-  size_t off = 0;
-  while (off < n) {
-    ssize_t k = ::recv(fd, (uint8_t*)buf + off, n - off, 0);
-    if (k > 0) {
-      off += (size_t)k;
-      continue;
-    }
-    if (k < 0 && err_wouldblock(errno)) {
-      int64_t left = deadline_ms - now_ms();
-      if (left <= 0) {
-        *timed_out = true;
-        *err = "recv deadline exceeded";
-        return false;
-      }
-      pollfd pfd{fd, POLLIN, 0};
-      ::poll(&pfd, 1, (int)(left > 200 ? 200 : left));
-      continue;
-    }
-    *err = std::string("recv: ") + (k == 0 ? "closed" : errno_str(errno));
-    return false;
-  }
-  return true;
-}
+// poll-bounded small-message helpers now live in the shared stripe layer
+// (stripe.h send_all/recv_all); these aliases keep the CMA control-message
+// call sites reading as before
+constexpr auto send_small = stripeio::send_all;
+constexpr auto recv_small = stripeio::recv_all;
 
 // process-wide hop counters for the env-gated injection points: the
 // schedule coordinate is "the nth hop this PROCESS runs", stable across
@@ -960,11 +891,7 @@ int DataPlane::allreduce(void* data, int64_t nelems, DpDtype dtype, DpOp op,
   // vectorizable and no stripe's chunk is pathologically small
   int ns = nstripes_;
   if (nelems < ns * 64) ns = 1;
-  std::vector<int64_t> sb(ns + 1);
-  for (int s = 0; s <= ns; ++s) {
-    sb[s] = ((nelems * s / ns) / 16) * 16;
-  }
-  sb[ns] = nelems;
+  std::vector<int64_t> sb = stripeio::stripe_bounds(nelems, ns, 16);
   for (int s = 0; s < ns; ++s) {
     auto& st = *stripes_[s];
     std::lock_guard<std::mutex> g(st.mu);
@@ -1042,10 +969,11 @@ extern "C" {
 // (v2: tft_dp_allreduce's `wire_bf16` int became the DpCodec enum — a
 // stale library would silently reinterpret codec=2 as wire_bf16=true;
 // v3: tft_lathist_snapshot/tft_lathist_reset added — a stale build would
-// fail the loader's symbol lookup at import).
+// fail the loader's symbol lookup at import;
+// v4: tft_blob_* striped checkpoint blob plane added (blob.cc)).
 // The Python loader (_native/__init__.py) refuses to run a mismatched
 // build and rebuilds in place.
-int tft_abi_version() { return 3; }
+int tft_abi_version() { return 4; }
 
 int64_t tft_dp_create(int rank, int world, int nstripes, char* err,
                       int errlen) {

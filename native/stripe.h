@@ -1,0 +1,137 @@
+// torchft_tpu native core — shared stripe layer.
+//
+// The framing and socket plumbing that used to live private to the
+// gradient data plane (dataplane.cc): frame headers, poll-bounded
+// small-message send/recv, socket tuning, and the deterministic stripe
+// partition. Factored out so BOTH striped planes — the ring allreduce
+// (dataplane.cc) and the checkpoint blob transfer (blob.cc) — speak one
+// dialect: same header shape, same deadline semantics, same torn-frame
+// failure mode (a cut connection surfaces as a short read, never as a
+// short frame that could be mistaken for data).
+#ifndef TFT_STRIPE_H_
+#define TFT_STRIPE_H_
+
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "rpc.h"  // now_ms / errno_str
+
+namespace tft {
+namespace stripeio {
+
+// one frame on a stripe socket: {tag, payload length}; the payload
+// follows immediately (dataplane hop frames and blob range replies both
+// validate the echoed header before trusting a single payload byte)
+struct HopHdr {
+  uint32_t tag;
+  uint32_t len;
+};
+
+constexpr int kSockBuf = 1 << 22;  // 4 MB: loopback throughput
+
+inline void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+inline void tune_socket(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int buf = kSockBuf;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+}
+
+// EAGAIN/EWOULDBLOCK may be the same value (they are on Linux) — the
+// guard keeps the portable double-check without tripping -Wlogical-op
+// in every nonblocking pump
+inline bool err_wouldblock(int e) {
+#if defined(EWOULDBLOCK) && EWOULDBLOCK != EAGAIN
+  if (e == EWOULDBLOCK) return true;
+#endif
+  return e == EAGAIN;
+}
+
+// poll-bounded helpers for small control messages and bulk payloads on a
+// nonblocking socket; both loop to the absolute deadline (now_ms clock)
+inline bool send_all(int fd, const void* buf, size_t n, int64_t deadline_ms,
+                     bool* timed_out, std::string* err) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t k = ::send(fd, (const uint8_t*)buf + off, n - off, MSG_NOSIGNAL);
+    if (k > 0) {
+      off += (size_t)k;
+      continue;
+    }
+    if (k < 0 && err_wouldblock(errno)) {
+      int64_t left = deadline_ms - now_ms();
+      if (left <= 0) {
+        *timed_out = true;
+        *err = "send deadline exceeded";
+        return false;
+      }
+      pollfd pfd{fd, POLLOUT, 0};
+      ::poll(&pfd, 1, (int)(left > 200 ? 200 : left));
+      continue;
+    }
+    *err = std::string("send: ") + (k == 0 ? "closed" : errno_str(errno));
+    return false;
+  }
+  return true;
+}
+
+inline bool recv_all(int fd, void* buf, size_t n, int64_t deadline_ms,
+                     bool* timed_out, std::string* err) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t k = ::recv(fd, (uint8_t*)buf + off, n - off, 0);
+    if (k > 0) {
+      off += (size_t)k;
+      continue;
+    }
+    if (k < 0 && err_wouldblock(errno)) {
+      int64_t left = deadline_ms - now_ms();
+      if (left <= 0) {
+        *timed_out = true;
+        *err = "recv deadline exceeded";
+        return false;
+      }
+      pollfd pfd{fd, POLLIN, 0};
+      ::poll(&pfd, 1, (int)(left > 200 ? 200 : left));
+      continue;
+    }
+    *err = std::string("recv: ") + (k == 0 ? "closed" : errno_str(errno));
+    return false;
+  }
+  return true;
+}
+
+// Deterministic stripe partition of `nelems` elements into at most
+// `nstripes` contiguous stripes, each boundary aligned down to `align`
+// elements (the data plane uses 16 so reduce loops stay vectorizable and
+// no stripe's chunk is pathologically small). bounds has nstripes+1
+// entries; stripe s covers [bounds[s], bounds[s+1]).
+inline std::vector<int64_t> stripe_bounds(int64_t nelems, int nstripes,
+                                          int64_t align) {
+  std::vector<int64_t> sb((size_t)nstripes + 1);
+  for (int s = 0; s <= nstripes; ++s) {
+    sb[(size_t)s] = ((nelems * s / nstripes) / align) * align;
+  }
+  sb[(size_t)nstripes] = nelems;
+  return sb;
+}
+
+}  // namespace stripeio
+}  // namespace tft
+
+#endif  // TFT_STRIPE_H_

@@ -152,6 +152,32 @@ class TestWireDriftFixtures:
         finds = [f for f in wiredrift.run() if f.rule == "wire-env-drift"]
         assert finds == []
 
+    def test_heal_env_drift_both_directions(self):
+        # code reads SOURCES (documented) and GHOST (undocumented); the
+        # doc additionally promises STALE, which nothing reads
+        py = {
+            "a.py": 'os.environ.get("TORCHFT_HEAL_SOURCES")\n'
+                    'os.environ.get("TORCHFT_HEAL_GHOST")\n',
+        }
+        doc = (
+            "| knob | default |\n"
+            "| `TORCHFT_HEAL_SOURCES` | 4 |\n"
+            "| `TORCHFT_HEAL_STALE` | 1 |\n"
+        )
+        finds = wiredrift.check_heal_env(py, doc)
+        msgs = {f.symbol: f.message for f in finds}
+        assert "TORCHFT_HEAL_GHOST" in msgs
+        assert "missing from" in msgs["TORCHFT_HEAL_GHOST"]
+        assert "TORCHFT_HEAL_STALE" in msgs
+        assert "no code reads" in msgs["TORCHFT_HEAL_STALE"]
+        assert "TORCHFT_HEAL_SOURCES" not in msgs
+
+    def test_heal_env_clean_tree(self):
+        # the live repo's TORCHFT_HEAL_* knob family must match the
+        # docs/heal_plane.md registry exactly (the ISSUE 9 satellite)
+        finds = [f for f in wiredrift.run() if f.rule == "heal-env-drift"]
+        assert finds == []
+
 
 # ---------------------------------------------------------------------------
 # doc-drift fixtures

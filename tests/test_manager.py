@@ -33,6 +33,8 @@ def quorum_result(
     max_world_size=2,
     recover_src_rank=None,
     recover_dst_ranks=(),
+    recover_src_addresses=(),
+    heal_pending=False,
 ):
     q = QuorumResult()
     q.quorum_id = quorum_id
@@ -46,6 +48,8 @@ def quorum_result(
     q.max_rank = max_rank
     q.max_world_size = max_world_size
     q.heal = heal
+    q.recover_src_addresses = list(recover_src_addresses)
+    q.heal_pending = heal_pending or heal or bool(recover_dst_ranks)
     return q
 
 
@@ -65,6 +69,12 @@ class ManagerHarness:
         self.load_state_dict = MagicMock()
         self.transport = MagicMock()
         self.transport.metadata.return_value = "transport_meta"
+        # the striped heal path prefers recv_checkpoint_multi when the
+        # transport has one (a MagicMock always does) — delegate to the
+        # recv_checkpoint.return_value contract the tests configure
+        self.transport.recv_checkpoint_multi.side_effect = (
+            lambda *a, **k: self.transport.recv_checkpoint.return_value
+        )
         kwargs.setdefault("min_replica_size", 2)
         kwargs.setdefault("timeout", timedelta(seconds=10))
         # patch stays active for the harness lifetime: the healing path
@@ -221,6 +231,96 @@ def test_quorum_send_checkpoint(harness):
     assert kwargs["dst_ranks"] == [0]
     assert kwargs["step"] == 7
     assert kwargs["state_dict"]["user"] == {"user_key": 1}
+
+
+def test_stripe_source_stages_without_assigned_healer(harness):
+    # ISSUE 9: when ANYONE heals this round (heal_pending), every
+    # up-to-date member stages — not just the round-robin-assigned
+    # sources — so the healer can pull a stripe from each of them
+    h = harness()
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(
+        max_rank=1, recover_dst_ranks=(), heal_pending=True, max_step=7,
+        recover_src_addresses=("a0", "a1"),
+    )
+    m.start_quorum()
+    m.wait_quorum()
+    h.transport.send_checkpoint.assert_called_once()
+    assert h.transport.send_checkpoint.call_args.kwargs["dst_ranks"] == []
+
+
+def test_stripe_source_staging_respects_single_source_knob(harness, monkeypatch):
+    monkeypatch.setenv("TORCHFT_HEAL_SOURCES", "1")
+    h = harness()
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(
+        max_rank=1, recover_dst_ranks=(), heal_pending=True, max_step=7,
+        recover_src_addresses=("a0", "a1"),
+    )
+    m.start_quorum()
+    m.wait_quorum()
+    h.transport.send_checkpoint.assert_not_called()
+
+
+def test_heal_uses_multi_source_with_cohort(harness):
+    # the healer resolves the whole max-step cohort (primary first) and
+    # hands the transport the multi-source list + the header warmup hook
+    h = harness()
+    m = h.manager
+    h.client._quorum.return_value = quorum_result(
+        heal=True, max_step=20, recover_src_rank=0,
+        recover_src_addresses=("manager address", "peer2 address"),
+    )
+    h.transport.recv_checkpoint.return_value = {
+        "user": {"recovered": True},
+        "torchft": {"step": 20, "batches_committed": 0},
+    }
+    m.start_quorum()
+    m.wait_quorum()
+    assert m._healing
+    call = h.transport.recv_checkpoint_multi.call_args
+    sources = call.args[0]
+    assert len(sources) == 2  # both cohort members' metadata resolved
+    assert call.kwargs["header_cb"] is not None
+
+
+def test_commit_trail_recorded_at_step_boundaries(harness, monkeypatch):
+    # TORCHFT_HEAL_DIFF=1: the Manager digests the committed state at
+    # every start_quorum and shares the trail with the transport (the
+    # differential heal's server half)
+    monkeypatch.setenv("TORCHFT_HEAL_DIFF", "1")
+    h = harness()
+    m = h.manager
+    assert m._heal_trail is not None
+    assert h.transport.commit_trail is m._heal_trail
+    h.client._quorum.return_value = quorum_result(max_rank=1)
+    m.start_quorum()
+    assert m._heal_trail.steps() == [0]
+    h.client.should_commit.return_value = True
+    assert m.should_commit()
+    m.start_quorum()
+    assert m._heal_trail.steps() == [0, 1]
+
+
+def test_heal_warmup_hook_fires_with_spec_tree(harness):
+    import threading
+
+    from torchft_tpu.checkpointing.serialization import flatten_state
+
+    h = harness()
+    m = h.manager
+    seen = []
+    done = threading.Event()
+
+    def warmup(spec):
+        seen.append(spec)
+        done.set()
+
+    m.set_heal_warmup(warmup)
+    header, _ = flatten_state({"w": np.zeros((3, 2), np.float32)})
+    m._heal_header_cb(header)
+    assert done.wait(5.0)
+    assert seen[0]["w"].shape == (3, 2)
 
 
 def test_error_latching(harness):

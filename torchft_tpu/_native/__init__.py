@@ -42,7 +42,8 @@ _TIMEOUT_CODES = (CANCELLED, DEADLINE_EXCEEDED)
 # the DpCodec enum — calling an old build with codec=2 would silently run
 # the bf16 wire, so a mismatch forces a rebuild instead of proceeding.
 # v3: tft_lathist_snapshot/tft_lathist_reset (native latency histograms).
-_ABI_VERSION = 3
+# v4: tft_blob_* (striped checkpoint blob plane, native/blob.cc).
+_ABI_VERSION = 4
 
 
 def _build(force: bool = False) -> None:
@@ -229,6 +230,26 @@ def _load() -> ctypes.CDLL:
     lib.tft_dp_allreduce.restype = c.c_int
     lib.tft_dp_free.argtypes = [c.c_int64]
     lib.tft_dp_free.restype = None
+
+    # striped checkpoint blob plane (native/blob.cc)
+    lib.tft_blob_serve_create.argtypes = [c.c_char_p, c.c_int]
+    lib.tft_blob_serve_create.restype = c.c_int64
+    lib.tft_blob_serve_port.argtypes = [c.c_int64]
+    lib.tft_blob_serve_port.restype = c.c_int
+    lib.tft_blob_stage.argtypes = [
+        c.c_int64, c.POINTER(c.c_uint64), c.POINTER(c.c_int64), c.c_int,
+        c.c_uint64, c.c_char_p, c.c_int,
+    ]
+    lib.tft_blob_stage.restype = c.c_int
+    lib.tft_blob_unstage.argtypes = [c.c_int64]
+    lib.tft_blob_unstage.restype = c.c_int
+    lib.tft_blob_serve_free.argtypes = [c.c_int64]
+    lib.tft_blob_serve_free.restype = None
+    lib.tft_blob_fetch.argtypes = [
+        c.c_char_p, c.c_int, c.c_uint64, c.c_uint64, c.c_uint64,
+        c.c_void_p, c.c_int64, c.c_char_p, c.c_int,
+    ]
+    lib.tft_blob_fetch.restype = c.c_int
 
     return lib
 
@@ -496,6 +517,78 @@ class DataPlaneError(ConnectionError):
     def __init__(self, peer_rank: int, msg: str) -> None:
         super().__init__(msg)
         self.peer_rank = peer_rank
+
+
+class BlobServer:
+    """ctypes wrapper for the striped checkpoint blob plane's serving
+    side (native/blob.cc): stages the flattened state tree's host buffers
+    (scattered — no coalescing copy) and serves arbitrary byte ranges of
+    their logical concatenation to healing peers, GIL-free. The caller
+    must keep the staged buffers alive until :meth:`unstage` returns."""
+
+    def __init__(self) -> None:
+        err = _errbuf()
+        self._h = _lib.tft_blob_serve_create(err, _ERRLEN)
+        if self._h == 0:
+            raise RuntimeError(f"blob server create: {err.value.decode()}")
+        self.port = int(_lib.tft_blob_serve_port(self._h))
+
+    def stage(self, ptrs: "list[int]", lens: "list[int]", token: int) -> None:
+        """Open the serving window over the buffers at ``ptrs``/``lens``
+        (base addresses + byte lengths, stream order). ``token`` names
+        this staging generation; fetches carrying any other token are
+        answered with a loud stale error, never stale bytes."""
+        n = len(ptrs)
+        arr_p = (ctypes.c_uint64 * n)(*ptrs)
+        arr_l = (ctypes.c_int64 * n)(*lens)
+        err = _errbuf()
+        rc = _lib.tft_blob_stage(self._h, arr_p, arr_l, n, token, err, _ERRLEN)
+        if rc != 0:
+            raise RuntimeError(f"blob stage: {err.value.decode()}")
+
+    def unstage(self) -> None:
+        """Close the serving window; returns once no in-flight serve
+        still reads the staged buffers (they may be freed after this)."""
+        if self._h:
+            _lib.tft_blob_unstage(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            _lib.tft_blob_serve_free(self._h)
+            self._h = 0
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def blob_fetch(
+    host: str,
+    port: int,
+    token: int,
+    offset: int,
+    length: int,
+    view: memoryview,
+    timeout_ms: int = 60000,
+) -> None:
+    """Pull ``length`` bytes at ``offset`` of the peer's staged blob
+    straight into the writable buffer ``view`` (the healer-side range
+    primitive; the GIL is released for the duration). Raises
+    TimeoutError on deadline, ConnectionError on any transfer failure —
+    a cut connection surfaces as a failed range, never short data."""
+    assert len(view) == length, (len(view), length)
+    buf = (ctypes.c_char * length).from_buffer(view)
+    err = _errbuf()
+    rc = _lib.tft_blob_fetch(
+        host.encode(), port, token, offset, length,
+        ctypes.addressof(buf), timeout_ms, err, _ERRLEN,
+    )
+    if rc == -2:
+        raise TimeoutError(f"blob fetch: {err.value.decode()}")
+    if rc != 0:
+        raise ConnectionError(f"blob fetch: {err.value.decode()}")
 
 
 class NativeDataPlane:

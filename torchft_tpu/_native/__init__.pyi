@@ -57,6 +57,23 @@ def compute_quorum_results(
 def cma_read(pid: int, addr: int, n: int) -> bytes: ...
 def cma_read_into(pid: int, addr: int, view: memoryview) -> None: ...
 
+class BlobServer:
+    port: int
+    def __init__(self) -> None: ...
+    def stage(self, ptrs: List[int], lens: List[int], token: int) -> None: ...
+    def unstage(self) -> None: ...
+    def close(self) -> None: ...
+
+def blob_fetch(
+    host: str,
+    port: int,
+    token: int,
+    offset: int,
+    length: int,
+    view: memoryview,
+    timeout_ms: int = ...,
+) -> None: ...
+
 class DataPlaneError(ConnectionError):
     peer_rank: int
     def __init__(self, peer_rank: int, msg: str) -> None: ...

@@ -42,6 +42,11 @@ Checks (rule ids):
     (``TORCHFT_SLO_*`` / ``TORCHFT_STRAGGLER_*``) against the knob
     registry in ``docs/observability.md``.
 
+``heal-env-drift``
+    Same contract for the heal-plane knob family (``TORCHFT_HEAL_*``)
+    against the knob registry in ``docs/heal_plane.md``, both
+    directions.
+
 ``fault-site-drift``
     Native evidence-record site labels (``fi::write_evidence`` /
     ``fi::kill_self`` call sites) vs ``faultinject.core.NATIVE_SITES``:
@@ -64,8 +69,9 @@ from torchft_tpu.analysis.base import Finding, repo_root
 __all__ = ["run", "scrape_cpp_enum", "scrape_py_constants"]
 
 _NATIVE_SOURCES = ("wire.h", "rpc.h", "coord.h", "dataplane.h",
-                   "faultinject.h", "rpc.cc", "coord.cc", "dataplane.cc",
-                   "capi.cc", "lighthouse_main.cc")
+                   "faultinject.h", "stripe.h", "blob.h", "rpc.cc",
+                   "coord.cc", "dataplane.cc", "blob.cc", "capi.cc",
+                   "lighthouse_main.cc")
 
 _PY_RPC_SOURCES = (
     "torchft_tpu/coordination.py",
@@ -300,6 +306,35 @@ def check_obs_env(
     return finds
 
 
+_HEAL_RE = re.compile(r"TORCHFT_HEAL_[A-Z0-9_]+")
+
+
+def check_heal_env(
+    py_texts: Dict[str, str], heal_doc_text: str
+) -> List[Finding]:
+    """The TORCHFT_HEAL_* knob family vs the docs/heal_plane.md knob
+    registry, both directions (the wire-env-drift contract for the
+    striped/differential heal plane)."""
+    py: Set[str] = set()
+    for text in py_texts.values():
+        py.update(_HEAL_RE.findall(text))
+    doc = set(_HEAL_RE.findall(heal_doc_text))
+    finds: List[Finding] = []
+    for k in sorted(py - doc):
+        finds.append(Finding(
+            "heal-env-drift", "docs/heal_plane.md", 0, k,
+            "heal-plane knob referenced in code but missing from the "
+            "docs/heal_plane.md knob registry — invisible to operators",
+        ))
+    for k in sorted(doc - py):
+        finds.append(Finding(
+            "heal-env-drift", "docs/heal_plane.md", 0, k,
+            "documented heal-plane knob that no code reads — a deploy "
+            "config setting it silently no-ops",
+        ))
+    return finds
+
+
 def check_fault_sites(
     native_texts: Dict[str, str], native_sites: tuple
 ) -> List[Finding]:
@@ -400,6 +435,13 @@ def run(root: Optional[str] = None) -> List[Finding]:
         else ""
     )
     out += check_obs_env(py_fi, obs_doc)
+    heal_doc_path = os.path.join(root, "docs", "heal_plane.md")
+    heal_doc = (
+        _read(root, "docs/heal_plane.md")
+        if os.path.exists(heal_doc_path)
+        else ""
+    )
+    out += check_heal_env(py_fi, heal_doc)
     out += check_fault_sites(native_texts, NATIVE_SITES)
     out += check_stub(native_init, pyi)
     return out

@@ -142,6 +142,122 @@ def _heal_worker_main(argv: List[str]) -> None:
     c.shutdown()
 
 
+def _heal_state(total_mb: float) -> Dict[str, object]:
+    """Deterministic 8-leaf state tree of ``total_mb`` (shared by the
+    striped-heal server processes and the in-parent verifier)."""
+    import numpy as np
+
+    n = int(total_mb * 1024 * 1024 / 4 / 8)
+    return {
+        f"w{i}": np.random.default_rng(i).standard_normal(n).astype(np.float32)
+        for i in range(8)
+    }
+
+
+def _striped_heal_server_main(argv: List[str]) -> None:
+    """One striped-heal source: stage the deterministic state on an
+    HTTPTransport (native blob plane included) and serve until the
+    parent closes stdin."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--total-mb", type=float, required=True)
+    args = parser.parse_args(argv)
+
+    from datetime import timedelta
+
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    t = HTTPTransport(timeout=timedelta(seconds=300), hostname="localhost")
+    t.send_checkpoint(
+        [1], 0, _heal_state(args.total_mb), timedelta(seconds=300)
+    )
+    print(json.dumps({"metadata": t.metadata()}), flush=True)
+    sys.stdin.readline()  # parent closes stdin when the client is done
+    t.shutdown()
+
+
+def _run_striped_heal(total_mb: float, nsources: int) -> Dict[str, object]:
+    """The ``heal_striped_{n}src`` rows: N server processes stage the
+    identical state; the healer (this process) pulls byte-balanced
+    stripes from all of them in parallel over the native blob plane
+    (docs/heal_plane.md). ``gb_per_sec`` is the aggregate; per-source
+    throughput rides along so a slow stripe is attributable."""
+    import numpy as np
+
+    from datetime import timedelta
+
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    procs = []
+    urls: List[str] = []
+    try:
+        for _ in range(nsources):
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        "-m",
+                        "torchft_tpu.benchmarks.crossgroup",
+                        "--striped-heal-server",
+                        "--total-mb",
+                        str(total_mb),
+                    ],
+                    stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    env=env,
+                )
+            )
+        for p in procs:
+            line = p.stdout.readline().decode().strip()
+            if not line:
+                raise RuntimeError(
+                    f"striped-heal server died: {p.stderr.read().decode()[-2000:]}"
+                )
+            urls.append(json.loads(line)["metadata"])
+        rx = HTTPTransport(timeout=timedelta(seconds=300), hostname="localhost")
+        try:
+            t0 = time.perf_counter()
+            got = rx.recv_checkpoint_multi(
+                urls, 0, timedelta(seconds=300)
+            )
+            dt = time.perf_counter() - t0
+            stats = dict(rx.last_heal_stats)
+        finally:
+            rx.shutdown()
+        expect = _heal_state(total_mb)
+        assert bool(
+            np.array_equal(np.asarray(got["w0"]), expect["w0"])
+            and np.array_equal(np.asarray(got["w7"]), expect["w7"])
+        ), "striped heal payload corrupted"
+        total_bytes = sum(int(np.asarray(v).nbytes) for v in expect.values())
+        return {
+            "seconds": round(dt, 4),
+            "gb_per_sec": round(total_bytes / dt / 1e9, 3),
+            "nsources": stats.get("nsources", nsources),
+            "per_source_gbps": {
+                src: s.get("gb_per_sec")
+                for src, s in (stats.get("sources") or {}).items()
+            },
+            "stages_s": stats.get("stages"),
+        }
+    finally:
+        for p in procs:
+            try:
+                if p.stdin:
+                    p.stdin.close()
+            except OSError:
+                pass
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
 def _run_heal_pair(total_mb: float, env_extra: Dict[str, str]) -> Dict[str, object]:
     from torchft_tpu.store import StoreServer
 
@@ -475,6 +591,21 @@ def measure_crossgroup(
         except Exception as e:  # noqa: BLE001 — best-effort matrix row
             out[name] = {"error": str(e)}
 
+    # striped multi-source heal (ISSUE 9): same bytes pulled from 1 vs 2
+    # sources over the native blob plane; the speedup row is the
+    # per-source parallel scaling the sub-second-heal acceptance reads
+    for name, nsrc in (("heal_striped_1src", 1), ("heal_striped_2src", 2)):
+        try:
+            out[name] = _run_striped_heal(total_mb, nsrc)
+        except Exception as e:  # noqa: BLE001 — best-effort matrix row
+            out[name] = {"error": str(e)}
+    try:
+        s1 = out["heal_striped_1src"]["gb_per_sec"]  # type: ignore[index]
+        s2 = out["heal_striped_2src"]["gb_per_sec"]  # type: ignore[index]
+        out["heal_striped_speedup"] = round(s2 / s1, 3) if s1 else None
+    except (KeyError, TypeError):
+        out["heal_striped_speedup"] = None
+
     variants = {
         "serial_r2": dict(wire_dtype="", serial=True),
         "pipelined": dict(wire_dtype="", serial=False),
@@ -555,6 +686,10 @@ def measure_compressed(
 
 
 def main() -> None:
+    if "--striped-heal-server" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--striped-heal-server"]
+        _striped_heal_server_main(argv)
+        return
     if "--heal-worker" in sys.argv:
         argv = [a for a in sys.argv[1:] if a != "--heal-worker"]
         _heal_worker_main(argv)

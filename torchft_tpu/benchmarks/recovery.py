@@ -83,6 +83,21 @@ def _worker() -> None:
     op_timeout = float(os.environ.get("TORCHFT_BENCH_OP_TIMEOUT", "1.0"))
     log = open(os.environ["TORCHFT_EVENT_LOG"], "a")
 
+    # Pre-import jax.tree_util on a side thread: the heal decode path
+    # (serialization._tree_util) pays this import on first use, and for
+    # this numpy-only worker that lands INSIDE rejoin-to-commit — the
+    # heal-stage ledger named it as the dominant decode cost. Starting
+    # the import now overlaps it with store bootstrap + quorum join
+    # (network waits release the GIL), pulling it off the rejoin
+    # serial path.
+    import threading
+
+    threading.Thread(
+        target=lambda: __import__("jax.tree_util"),
+        daemon=True,
+        name="tft_prewarm_tree",
+    ).start()
+
     params = {"w": np.zeros((256, 256), np.float32), "steps_seen": 0}
 
     def state_dict() -> Dict[str, object]:
@@ -106,6 +121,7 @@ def _worker() -> None:
     )
     _emit(log, event="start", gid=gid, pid=os.getpid())
     rng = np.random.default_rng(gid)
+    heal_stats_seen: Dict[str, object] = {}
     try:
         while manager.current_step() < total_steps:
             try:
@@ -124,6 +140,13 @@ def _worker() -> None:
             if committed:
                 params["w"] -= 0.01 * grad
                 params["steps_seen"] += 1
+                # latch this worker's most recent heal attribution (the
+                # multi-source transport fills it; empty pre-heal)
+                stats = getattr(
+                    manager._checkpoint_transport, "last_heal_stats", None
+                )
+                if isinstance(stats, dict) and stats.get("stages"):
+                    heal_stats_seen = stats
                 _emit(
                     log,
                     event="commit",
@@ -132,6 +155,30 @@ def _worker() -> None:
                     pid=os.getpid(),
                 )
     finally:
+        # rejoin-SLO + heal-stage attribution for the bench row (ISSUE 9):
+        # the orchestrator reads these from the rejoiner's log so the
+        # envelope numbers come with their per-stage explanation
+        try:
+            from torchft_tpu import telemetry
+
+            slo = manager._slo.rejoin
+            _emit(
+                log,
+                event="slo",
+                gid=gid,
+                rejoin_threshold_s=(slo.threshold_s if slo else 0.0),
+                rejoin_breached=bool(slo.breached) if slo else False,
+                rejoin_breaches=int(slo.breaches) if slo else 0,
+            )
+            _emit(
+                log,
+                event="heal_stats",
+                gid=gid,
+                stats=heal_stats_seen,
+                stages=telemetry.LEDGER.heal_stage_snapshot(),
+            )
+        except Exception:  # noqa: BLE001 — attribution must not fail the run
+            pass
         manager.shutdown(wait=False)
         _emit(log, event="exit", gid=gid, pid=os.getpid())
         log.close()
@@ -163,6 +210,11 @@ class RecoveryResult:
     # Perfetto) and the /cluster.json per-replica health snapshot
     merged_trace_path: Optional[str] = None
     cluster: Optional[Dict] = None
+    # ISSUE 9: rejoin-to-commit SLO verdict (TORCHFT_SLO_REJOIN_S wired
+    # into the workers) + the rejoiner's heal attribution (per-source
+    # stripe throughput, meta/recv/decode/device_put stage split)
+    rejoin_slo: Optional[Dict] = None
+    rejoin_heal: Optional[Dict] = None
 
     def as_dict(self) -> Dict[str, object]:
         out: Dict[str, object] = {
@@ -174,6 +226,11 @@ class RecoveryResult:
             ),
             "survivor_steps_lost": self.survivor_steps_lost,
         }
+        if self.rejoin_slo is not None:
+            out["rejoin_slo_s"] = self.rejoin_slo.get("rejoin_threshold_s")
+            out["slo_breach"] = bool(self.rejoin_slo.get("rejoin_breached"))
+        if self.rejoin_heal is not None:
+            out["rejoin_heal"] = self.rejoin_heal
         if self.ft_events is not None:
             out["ft_events"] = self.ft_events
         return out
@@ -251,6 +308,7 @@ def measure_recovery(
     heartbeat_timeout_ms: int = 1000,
     timeout_s: float = 120.0,
     num_groups: int = 2,
+    rejoin_slo_s: float = 1.0,
 ) -> RecoveryResult:
     """Kill 1 of ``num_groups`` replica groups and measure the envelope
     (``num_groups=4`` is the BASELINE north-star shape: survive killing
@@ -278,6 +336,10 @@ def measure_recovery(
         "TORCHFT_BENCH_OP_TIMEOUT": str(op_timeout),
         # hang forensics land next to the trails (flight dumps per pid)
         "TORCHFT_FLIGHT_DIR": tmp,
+        # rejoin-to-commit SLO (telemetry/slo.py BurnRateSlo): the
+        # rejoiner's Manager evaluates it live; the bench row reports the
+        # latch state next to the measured wall-clock
+        "TORCHFT_SLO_REJOIN_S": str(rejoin_slo_s),
     }
     procs: List[Optional[subprocess.Popen]] = [None] * num_groups
     try:
@@ -366,6 +428,34 @@ def measure_recovery(
         if fetch_merged_trace(lighthouse.address(), path=merged_trace_path) is None:
             merged_trace_path = None
         cluster = poll_cluster(lighthouse.address())
+        # the rejoiner's SLO verdict + heal attribution: take the LAST
+        # slo/heal_stats records in its log — those are the respawned
+        # incarnation's (the killed one's records, if any, precede them)
+        rejoin_events = _read_events(logs[victim_gid])
+        rejoin_slo = next(
+            (e for e in reversed(rejoin_events) if e["event"] == "slo"), None
+        )
+        rejoin_heal = None
+        hs = next(
+            (
+                e
+                for e in reversed(rejoin_events)
+                if e["event"] == "heal_stats" and e.get("t", 0) > t_respawn
+            ),
+            None,
+        )
+        if hs is not None and (hs.get("stats") or hs.get("stages")):
+            stats = hs.get("stats") or {}
+            rejoin_heal = {
+                "mode": stats.get("mode"),
+                "bytes": stats.get("bytes"),
+                "nsources": stats.get("nsources"),
+                "per_source_gbps": {
+                    src: s.get("gb_per_sec")
+                    for src, s in (stats.get("sources") or {}).items()
+                },
+                "stages_s": hs.get("stages") or stats.get("stages"),
+            }
         return RecoveryResult(
             survivor_blackout_s=blackout,
             rejoin_to_commit_s=rejoin["t"] - t_respawn,
@@ -378,6 +468,8 @@ def measure_recovery(
             t_respawn_unix=t_respawn,
             merged_trace_path=merged_trace_path,
             cluster=cluster,
+            rejoin_slo=rejoin_slo,
+            rejoin_heal=rejoin_heal,
         )
     finally:
         for p in procs:

@@ -24,14 +24,23 @@ class RWLock:
         self._writer = False  # guarded-by: _cond
         self._want_write = 0  # pending writers block new readers; guarded-by: _cond
 
-    def _wait(self, predicate) -> None:
-        ok = self._cond.wait_for(predicate, timeout=self._timeout)
+    def _wait(self, predicate, timeout: Optional[float] = None) -> None:
+        timeout = self._timeout if timeout is None else timeout
+        ok = self._cond.wait_for(predicate, timeout=timeout)
         if not ok:
-            raise TimeoutError(f"rwlock acquire timed out after {self._timeout}s")
+            raise TimeoutError(f"rwlock acquire timed out after {timeout}s")
 
-    def r_acquire(self) -> None:
+    def r_acquire(self, timeout: Optional[float] = None) -> None:
+        """``timeout`` overrides the lock-wide default for this acquire —
+        the heal metadata endpoints use a short bound so a healer probing
+        a source that will NEVER stage this round (e.g. one whose quorum
+        ran allow_heal=False) fails fast instead of burning the full
+        transfer timeout (docs/heal_plane.md)."""
         with self._cond:
-            self._wait(lambda: not self._writer and self._want_write == 0)
+            self._wait(
+                lambda: not self._writer and self._want_write == 0,
+                timeout=timeout,
+            )
             self._readers += 1
 
     def r_release(self) -> None:

@@ -6,32 +6,66 @@ GETs block while no checkpoint is staged) and http.py (IPv6 server with a
 deep accept backlog). Same design here, serving JAX pytrees via the raw
 buffer streaming in :mod:`torchft_tpu.checkpointing.serialization`.
 
+Beyond the reference (docs/heal_plane.md):
+
+* **Striped multi-source heal** — :meth:`HTTPTransport.recv_checkpoint_multi`
+  pulls byte-balanced ranges of the flattened state tree from EVERY live
+  peer in parallel (work-queue scheduling, so a source dying mid-heal just
+  hands its pending ranges to the survivors). The bulk bytes ride the
+  native blob plane (``native/blob.cc``, GIL-free, shared stripe layer
+  with the gradient data plane) when available, with the HTTP
+  ``/range_{offset}_{len}`` endpoint as the fallback; metadata, the
+  stripe plan and the differential negotiation stay on HTTP.
+* **Differential heal** — a healer that still holds the committed state
+  at its last step asks ``/delta_{since}_{digest}`` and receives only the
+  leaves that changed since (:mod:`torchft_tpu.checkpointing.delta`).
+* **Consistency by digest** — every source's ``/stripemeta`` carries the
+  staged tree digest; the healer only stripes across sources whose
+  digests agree with the primary's (so e.g. LocalSGD groups with diverged
+  inner state automatically degrade to single-source heal instead of
+  mixing bytes from two different states).
+
 Chunked mode (``num_chunks > 0``): the header plus a chunk manifest is
-served at ``/metadata``; array buffers are split round-robin by size into
-``num_chunks`` groups fetched in parallel — the analogue of the reference's
-parallel chunk GETs (http_transport.py:243-266).
+served at ``/metadata``; array buffers are grouped by greedy-LPT size
+balance into ``num_chunks`` groups fetched in parallel — the analogue of
+the reference's parallel chunk GETs (http_transport.py:243-266). The
+striped path above supersedes it for heals (byte ranges balance exactly
+where whole-buffer LPT cannot), but the endpoint remains for tooling.
 """
 
 from __future__ import annotations
 
 import logging
+import os
+import pickle
 import socket
+import struct
 import threading
 import time
+import urllib.parse
 import urllib.request
+from collections import deque
 from datetime import timedelta
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from concurrent.futures import ThreadPoolExecutor
-from typing import Generic, List, Optional, Tuple, TypeVar
+from typing import Any, Callable, Dict, Generic, List, Optional, Tuple, TypeVar
 
 import numpy as np
 
 from torchft_tpu import telemetry
 from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing import delta as delta_mod
 from torchft_tpu.checkpointing.serialization import (
     as_bytes,
     flatten_state,
     unflatten_state,
+)
+from torchft_tpu.checkpointing.stripes import (
+    assign_chunk_groups,
+    heal_sources_limit,
+    heal_stripes_per_source,
+    slice_buffers,
+    stripe_ranges,
 )
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
@@ -50,6 +84,43 @@ class _Server(ThreadingHTTPServer):
 
 TRACE_HEADER = "X-TFT-Trace"
 
+# staging tokens are process-global so a transport recreated in-place can
+# never reissue a token an old healer still holds
+_STAGING_TOKEN = iter(range(1, 1 << 62))
+_STAGING_TOKEN_LOCK = threading.Lock()
+
+
+def _next_token() -> int:
+    with _STAGING_TOKEN_LOCK:
+        return next(_STAGING_TOKEN)
+
+
+def _heal_digest_enabled() -> bool:
+    """``TORCHFT_HEAL_DIGEST=0`` disables staging digests — and with
+    them multi-source striping AND differential heal (both are
+    digest-anchored); heals then behave like the single-source
+    reference path."""
+    return os.environ.get("TORCHFT_HEAL_DIGEST", "1") != "0"
+
+
+def _heal_meta_timeout_s() -> float:
+    """Staging-window wait bound for the striped-heal endpoints
+    (``TORCHFT_HEAL_META_TIMEOUT_S``, default 5): long enough for a
+    source mid-staging (flatten+digest complete in well under this for
+    any state the full timeout could move anyway), short enough that a
+    source that will not stage this round costs seconds, not the
+    transfer timeout."""
+    try:
+        return float(os.environ.get("TORCHFT_HEAL_META_TIMEOUT_S", "5"))
+    except ValueError:
+        return 5.0
+
+
+def _heal_native_enabled() -> bool:
+    """``TORCHFT_HEAL_NATIVE=0`` keeps heal bytes on HTTP (the native
+    blob plane is the default bulk path when the core is loadable)."""
+    return os.environ.get("TORCHFT_HEAL_NATIVE", "1") != "0"
+
 
 def _traced_urlopen(url: str, timeout: float):
     """urlopen with the caller's trace context attached, so the serving
@@ -66,18 +137,9 @@ def _traced_urlopen(url: str, timeout: float):
     return urllib.request.urlopen(req, timeout=timeout)
 
 
-def _assign_chunks(sizes: List[int], num_chunks: int) -> List[List[int]]:
-    """Greedy size-balanced assignment of buffer indices to chunks."""
-    order = sorted(range(len(sizes)), key=lambda i: -sizes[i])
-    totals = [0] * num_chunks
-    groups: List[List[int]] = [[] for _ in range(num_chunks)]
-    for i in order:
-        c = totals.index(min(totals))
-        groups[c].append(i)
-        totals[c] += sizes[i]
-    for g in groups:
-        g.sort()  # stream each chunk's buffers in deterministic order
-    return groups
+# retained import surface: the chunk grouping moved to stripes.py (shared
+# with tests and the heal planner)
+_assign_chunks = assign_chunk_groups
 
 
 class HTTPTransport(CheckpointTransport[T], Generic[T]):
@@ -95,12 +157,28 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
         # payload size of the last recv_checkpoint — the Manager reads it
         # for the heal_end event's bytes field
         self.last_recv_bytes: int = 0
+        # per-source throughput + stage attribution of the last
+        # multi-source recv (docs/heal_plane.md; the Manager embeds it in
+        # heal_end and the recovery bench exports it)
+        self.last_heal_stats: Dict[str, Any] = {}
+        # differential-heal digest trail (checkpointing/delta.CommitTrail)
+        # — attached by the Manager when TORCHFT_HEAL_DIFF is on
+        self.commit_trail: Optional[delta_mod.CommitTrail] = None
 
         self._lock = RWLock(timeout=timeout.total_seconds())
         self._step: Optional[int] = None
         self._header: Optional[bytes] = None
         self._buffers: List[np.ndarray] = []
+        self._sizes: List[int] = []
+        self._total = 0
+        self._digests: Optional[List[str]] = None
+        self._tree_digest: Optional[str] = None
         self._groups: List[List[int]] = []
+        self._token = 0
+        # native blob server (bulk heal bytes), created lazily at first
+        # staging; None when the native core is unavailable or disabled
+        self._blob = None
+        self._blob_failed = False
         # serving starts disallowed: readers block until first staging.
         # _allowed tracks whether the write lock is currently released (the
         # serving window is open); only the manager's quorum/commit path
@@ -137,13 +215,25 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
                 # the read lock forever (which would block the next
                 # disallow_checkpoint and fail should_commit on this side)
                 self.connection.settimeout(transport._timeout.total_seconds())
+                parts = self.path.strip("/").split("/")
+                # striped-heal endpoints wait only briefly for a staging
+                # window: a healer probing a source whose quorum round ran
+                # allow_heal=False (death-watch re-quorum racing a rejoin)
+                # would otherwise park for the full transfer timeout on a
+                # window that never opens this round — it should drop the
+                # source fast and retry next quorum (docs/heal_plane.md)
+                bounded = len(parts) == 3 and (
+                    parts[2] == "stripemeta"
+                    or parts[2].startswith(("range_", "delta_"))
+                )
                 try:
-                    transport._lock.r_acquire()
+                    transport._lock.r_acquire(
+                        timeout=_heal_meta_timeout_s() if bounded else None
+                    )
                 except TimeoutError:
                     self.send_error(503, "no checkpoint staged within timeout")
                     return
                 try:
-                    parts = self.path.strip("/").split("/")
                     # /checkpoint/{step}/{what}
                     if len(parts) != 3 or parts[0] != "checkpoint":
                         self.send_error(404, f"bad path {self.path}")
@@ -159,8 +249,18 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
                         payload = transport._render_full()
                     elif what == "metadata":
                         payload = transport._render_metadata()
+                    elif what == "stripemeta":
+                        payload = transport._render_stripemeta()
                     elif what.startswith("chunk_"):
                         payload = transport._render_chunk(int(what[len("chunk_") :]))
+                    elif what.startswith("range_"):
+                        _, off_s, len_s = what.split("_")
+                        payload = transport._render_range(
+                            int(off_s), int(len_s)
+                        )
+                    elif what.startswith("delta_"):
+                        _, since_s, digest = what.split("_")
+                        payload = transport._render_delta(int(since_s), digest)
                     else:
                         self.send_error(404, f"bad path {self.path}")
                         return
@@ -254,20 +354,64 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
     # -- render (read lock held) --
 
     def _render_full(self) -> List[bytes]:
-        import struct
-
         assert self._header is not None
         out = [struct.pack("<Q", len(self._header)), self._header]
         out.extend(as_bytes(b) for b in self._buffers)
         return out
 
     def _render_metadata(self) -> List[bytes]:
-        import pickle
-
         return [pickle.dumps((self._header, self._groups))]
 
     def _render_chunk(self, i: int) -> List[bytes]:
         return [as_bytes(self._buffers[j]) for j in self._groups[i]]
+
+    def _render_stripemeta(self) -> List[bytes]:
+        """Everything a healer needs to plan + verify a striped fetch
+        from THIS source: the header (treedef + leaf infos), the buffer
+        byte layout, the staging token, the staged tree digest (None when
+        digests are disabled) and the native blob port (None when the
+        bulk path is HTTP-only)."""
+        blob = self._blob
+        meta = {
+            "step": self._step,
+            "header": self._header,
+            "sizes": list(self._sizes),
+            "total": self._total,
+            "tree_digest": self._tree_digest,
+            "token": self._token,
+            "blob_port": getattr(blob, "port", None),
+        }
+        return [pickle.dumps(meta)]
+
+    def _render_range(self, offset: int, length: int) -> List[bytes]:
+        if offset < 0 or length <= 0 or offset + length > self._total:
+            raise ValueError(
+                f"bad range [{offset}, {offset + length}) of {self._total}"
+            )
+        return list(
+            slice_buffers(self._buffers, self._sizes, offset, length)
+        )
+
+    def _render_delta(self, since_step: int, healer_digest: str) -> List[bytes]:
+        """Differential response: only the buffers that changed since the
+        healer's last committed step — or a loud ``{"mode": "full"}``
+        refusal whenever a delta is not provably sound (no trail entry
+        for that step, digest mismatch, digests disabled)."""
+        assert self._header is not None
+        trail = self.commit_trail
+        built = None
+        if trail is not None and self._digests is not None:
+            built = delta_mod.build_delta(
+                self._header,
+                self._buffers,
+                self._digests,
+                trail.get(since_step),
+                healer_digest,
+            )
+        if built is None:
+            return delta_mod.pack_delta({"mode": "full"}, [])
+        manifest, changed = built
+        return delta_mod.pack_delta(manifest, changed)
 
     # -- CheckpointTransport --
 
@@ -283,6 +427,10 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
         self.disallow_checkpoint()
         t0 = time.perf_counter()
         header, buffers = flatten_state(state_dict)
+        # pin contiguity: the blob plane serves raw base pointers, and
+        # _to_host already returns contiguous arrays — this is a no-op
+        # guard against exotic leaf types
+        buffers = [np.ascontiguousarray(b) for b in buffers]
         nbytes = len(header) + sum(int(b.nbytes) for b in buffers)
         telemetry.record_checkpoint(
             "stage", nbytes, time.perf_counter() - t0, "http"
@@ -296,18 +444,67 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
         )
         self._header = header
         self._buffers = buffers
+        self._sizes = [int(b.nbytes) for b in buffers]
+        self._total = sum(self._sizes)
+        if _heal_digest_enabled():
+            trail = self.commit_trail
+            digests = None
+            if trail is not None:
+                # the Manager records the trail from the SAME state at the
+                # step boundary; reuse its digests instead of re-hashing
+                ent = trail.get(step)
+                if ent is not None and ent["sizes"] == self._sizes:
+                    digests = list(ent["leaves"])
+            if digests is None:
+                digests = delta_mod.leaf_digests(buffers)
+                if trail is not None:
+                    trail.record(step, buffers, digests=digests)
+            self._digests = digests
+            self._tree_digest = delta_mod.tree_digest(digests)
+        else:
+            self._digests = None
+            self._tree_digest = None
         nchunks = min(self._num_chunks, len(buffers)) if self._num_chunks else 0
         self._groups = (
-            _assign_chunks([b.nbytes for b in buffers], nchunks) if nchunks else []
+            assign_chunk_groups(self._sizes, nchunks) if nchunks else []
         )
         self._step = step
+        self._token = _next_token()
+        self._stage_blob()
         self._lock.w_release()  # open the serving window
         self._allowed = True
+
+    def _stage_blob(self) -> None:
+        """Stage the flattened buffers on the native blob plane (bulk
+        heal bytes, GIL-free). Best-effort: any failure falls back to the
+        HTTP range endpoint — the stripemeta simply advertises no port."""
+        if not _heal_native_enabled() or self._blob_failed:
+            return
+        try:
+            if self._blob is None:
+                from torchft_tpu import _native
+
+                self._blob = _native.BlobServer()
+            self._blob.stage(
+                [b.ctypes.data for b in self._buffers],
+                self._sizes,
+                self._token,
+            )
+        except Exception as e:  # noqa: BLE001 — HTTP fallback stays correct
+            logger.warning("native blob staging unavailable: %s", e)
+            self._blob = None
+            self._blob_failed = True
 
     def disallow_checkpoint(self) -> None:
         if self._allowed:
             self._lock.w_acquire()
             self._allowed = False
+        if self._blob is not None:
+            # returns once no in-flight native serve still reads the
+            # staged buffers, so the next staging may drop them
+            self._blob.unstage()
+
+    # -- single-source receive (reference path) --
 
     def _fetch_full(self, base: str, secs: float, step: int) -> T:
         t0 = time.perf_counter()
@@ -341,8 +538,6 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
         if self._num_chunks == 0:
             return self._fetch_full(base, secs, step)
 
-        import pickle
-
         t0 = time.perf_counter()
         with _traced_urlopen(f"{base}/metadata", timeout=secs) as resp:
             header, groups = pickle.loads(resp.read())
@@ -372,8 +567,313 @@ class HTTPTransport(CheckpointTransport[T], Generic[T]):
         )
         return unflatten_state(header, [b for b in buffers if b is not None])
 
+    # -- striped multi-source receive (docs/heal_plane.md) --
+
+    def recv_checkpoint_multi(
+        self,
+        sources: List[str],
+        step: int,
+        timeout: timedelta,
+        since_step: Optional[int] = None,
+        own: Optional[Tuple[List[np.ndarray], str]] = None,
+        header_cb: Optional[Callable[[bytes], None]] = None,
+    ) -> T:
+        """Fetch ``step``'s state dict striped across ``sources`` (each a
+        transport metadata URL; ``sources[0]`` is the lighthouse-named
+        primary). With ``since_step``/``own`` the differential fast path
+        is tried first (``own`` = this replica's flattened buffers + tree
+        digest at ``since_step``). ``header_cb`` fires as soon as the
+        header is known — before any bulk bytes land — so the caller can
+        overlap jit compile/warmup with the transfer."""
+        from torchft_tpu.faultinject.core import fault_point
+
+        fault_point("ckpt.recv", match=str(step), step=step)
+        assert sources, "need at least one heal source"
+        secs = timeout.total_seconds()
+        deadline = time.monotonic() + secs
+        t_start = time.perf_counter()
+        stats: Dict[str, Any] = {
+            "mode": "striped",
+            "sources": {},
+            "stages": {},
+        }
+        self.last_heal_stats = stats
+
+        # ---- differential fast path -----------------------------------
+        if since_step is not None and own is not None:
+            state = self._try_delta(
+                sources[0], step, since_step, own, secs, stats,
+                header_cb=header_cb,
+            )
+            if state is not None:
+                self._record_recv(
+                    int(stats["bytes"]), time.perf_counter() - t_start, step
+                )
+                return state
+
+        # ---- stripe planning ------------------------------------------
+        t0 = time.perf_counter()
+        sources = sources[: heal_sources_limit()]
+        metas: Dict[str, Dict[str, Any]] = {}
+        meta_errors: Dict[str, str] = {}
+
+        # bounded per-source planning probe: the server answers within
+        # _heal_meta_timeout_s (or 503s), so a blackholed host must not
+        # consume the whole transfer deadline before a single range moves
+        meta_secs = min(secs, _heal_meta_timeout_s() + 5.0)
+
+        def fetch_meta(src: str) -> None:
+            try:
+                with _traced_urlopen(
+                    f"{src}/checkpoint/{step}/stripemeta", timeout=meta_secs
+                ) as r:
+                    metas[src] = pickle.loads(r.read())
+            except Exception as e:  # noqa: BLE001 — a dead source is dropped
+                meta_errors[src] = str(e)
+
+        if len(sources) == 1:
+            fetch_meta(sources[0])
+        else:
+            with ThreadPoolExecutor(
+                max_workers=len(sources), thread_name_prefix="tft_heal_meta"
+            ) as pool:
+                list(pool.map(fetch_meta, sources))
+        alive = [s for s in sources if s in metas]
+        if not alive:
+            raise ConnectionError(
+                f"no heal source reachable for step {step}: {meta_errors}"
+            )
+        primary = alive[0]
+        pmeta = metas[primary]
+        if pmeta.get("tree_digest"):
+            # stripe only across sources provably staging the SAME bytes;
+            # anything else (diverged LocalSGD inner state, a source that
+            # re-staged a different step mid-plan) degrades to fewer
+            # sources rather than ever mixing two states
+            active = []
+            for s in alive:
+                if metas[s].get("tree_digest") == pmeta["tree_digest"]:
+                    active.append(s)
+                else:
+                    logger.warning(
+                        "heal source %s staged a different tree than the "
+                        "primary (digest %s vs %s, %d vs %d bytes, header "
+                        "%d vs %d) — excluded from striping",
+                        s,
+                        metas[s].get("tree_digest"),
+                        pmeta["tree_digest"],
+                        metas[s].get("total"),
+                        pmeta.get("total"),
+                        len(metas[s].get("header") or b""),
+                        len(pmeta.get("header") or b""),
+                    )
+        else:
+            active = [primary]
+        header: bytes = pmeta["header"]
+        sizes: List[int] = list(pmeta["sizes"])
+        total: int = int(pmeta["total"])
+        telemetry.LEDGER.record_heal_stage(
+            "meta", time.perf_counter() - t0
+        )
+        stats["stages"]["meta_s"] = round(time.perf_counter() - t0, 4)
+
+        if header_cb is not None:
+            try:
+                header_cb(header)
+            except Exception:  # noqa: BLE001 — warmup is best-effort
+                logger.exception("heal header callback failed")
+
+        # ---- striped fetch (work queue: a dead source's pending ranges
+        # re-stripe onto the survivors) ---------------------------------
+        t0 = time.perf_counter()
+        dest = bytearray(total)
+        mv = memoryview(dest)
+        ranges = stripe_ranges(total, len(active) * heal_stripes_per_source())
+        queue: deque = deque(ranges)
+        qlock = threading.Lock()
+        failures: Dict[str, str] = {}
+        done_bytes = [0]
+
+        def fetch_range(src: str, off: int, length: int) -> None:
+            left = max(0.1, deadline - time.monotonic())
+            meta = metas[src]
+            view = mv[off : off + length]
+            if (
+                meta.get("blob_port")
+                and _heal_native_enabled()
+                and not self._blob_failed
+            ):
+                from torchft_tpu import _native
+
+                host = urllib.parse.urlsplit(src).hostname or "localhost"
+                _native.blob_fetch(
+                    host,
+                    int(meta["blob_port"]),
+                    int(meta["token"]),
+                    off,
+                    length,
+                    view,
+                    timeout_ms=int(left * 1000),
+                )
+            else:
+                url = f"{src}/checkpoint/{step}/range_{off}_{length}"
+                with _traced_urlopen(url, timeout=left) as r:
+                    got = 0
+                    while got < length:
+                        k = r.readinto(view[got:])
+                        if not k:
+                            raise EOFError(
+                                f"short range read {got}/{length} from {src}"
+                            )
+                        got += k
+
+        def worker(src: str) -> None:
+            srcstat = stats["sources"].setdefault(
+                src, {"bytes": 0, "seconds": 0.0, "ranges": 0}
+            )
+            while True:
+                with qlock:
+                    if not queue:
+                        return
+                    off, length = queue.popleft()
+                ts = time.perf_counter()
+                try:
+                    fetch_range(src, off, length)
+                except Exception as e:  # noqa: BLE001 — re-stripe and retire
+                    with qlock:
+                        queue.append((off, length))
+                        failures[src] = str(e)
+                    logger.warning(
+                        "heal source %s failed mid-stripe (%s); "
+                        "re-striping its ranges over survivors",
+                        src,
+                        e,
+                    )
+                    return
+                dur = time.perf_counter() - ts
+                srcstat["bytes"] += length
+                srcstat["seconds"] += dur
+                srcstat["ranges"] += 1
+                with qlock:
+                    done_bytes[0] += length
+
+        # re-striping loop: a worker that observed an empty queue exits,
+        # but a FAILING worker may re-queue its in-flight range after
+        # that — so keep relaunching workers for the surviving sources
+        # until the queue drains or every source has failed (each pass
+        # either finishes the queue or retires at least one source, so
+        # the loop is bounded by len(active))
+        while queue and len(failures) < len(active):
+            survivors = [s for s in active if s not in failures]
+            threads = [
+                threading.Thread(
+                    target=worker, args=(s,), name=f"tft_heal_stripe{i}"
+                )
+                for i, s in enumerate(survivors)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        if done_bytes[0] != total:
+            raise ConnectionError(
+                f"striped heal incomplete: {done_bytes[0]}/{total} bytes "
+                f"(source failures: {failures or meta_errors})"
+            )
+        recv_s = time.perf_counter() - t0
+        telemetry.LEDGER.record_heal_stage("recv", recv_s)
+        for src, st in stats["sources"].items():
+            st["gb_per_sec"] = (
+                round(st["bytes"] / st["seconds"] / 1e9, 3)
+                if st["seconds"] > 0
+                else 0.0
+            )
+        stats["stages"]["recv_s"] = round(recv_s, 4)
+        stats["nsources"] = len(active) - len(failures)
+        stats["failures"] = failures
+
+        # ---- decode ----------------------------------------------------
+        t0 = time.perf_counter()
+        buffers: List[np.ndarray] = []
+        off = 0
+        for s in sizes:
+            buffers.append(
+                np.frombuffer(dest, dtype=np.uint8, count=s, offset=off)
+            )
+            off += s
+        state = unflatten_state(header, buffers)
+        decode_s = time.perf_counter() - t0
+        telemetry.LEDGER.record_heal_stage("decode", decode_s)
+        stats["stages"]["decode_s"] = round(decode_s, 4)
+        stats["bytes"] = len(header) + total
+        self._record_recv(
+            len(header) + total, time.perf_counter() - t_start, step
+        )
+        return state
+
+    def _try_delta(
+        self,
+        primary: str,
+        step: int,
+        since_step: int,
+        own: Tuple[List[np.ndarray], str],
+        secs: float,
+        stats: Dict[str, Any],
+        header_cb: Optional[Callable[[bytes], None]] = None,
+    ) -> Optional[T]:
+        """Differential attempt against the primary source; None on any
+        refusal/failure (the caller proceeds with the striped full path)."""
+        own_buffers, own_digest = own
+        t0 = time.perf_counter()
+        try:
+            url = (
+                f"{primary}/checkpoint/{step}/delta_{since_step}_{own_digest}"
+            )
+            with _traced_urlopen(url, timeout=secs) as r:
+                body = r.read()
+            manifest, payload = delta_mod.unpack_delta(body)
+            if manifest.get("mode") != "delta":
+                return None
+            if header_cb is not None:
+                # the heal/compile overlap applies to delta heals too —
+                # fire the warmup before the (decode) apply
+                try:
+                    header_cb(manifest["header"])
+                except Exception:  # noqa: BLE001 — warmup is best-effort
+                    logger.exception("heal header callback failed")
+            header, buffers = delta_mod.apply_delta(
+                manifest, payload, own_buffers
+            )
+            state = unflatten_state(header, buffers)
+        except Exception as e:  # noqa: BLE001 — degrade to the full path
+            logger.warning(
+                "differential heal unavailable (%s); falling back to full",
+                e,
+            )
+            return None
+        dur = time.perf_counter() - t0
+        telemetry.LEDGER.record_heal_stage("recv", dur)
+        stats["mode"] = "delta"
+        stats["bytes"] = len(body)
+        stats["delta"] = {
+            "since_step": since_step,
+            "changed": len(manifest["changed"]),
+            "leaves": len(own_buffers),
+            "bytes": len(body),
+            "seconds": round(dur, 4),
+        }
+        stats["sources"][primary] = {
+            "bytes": len(body),
+            "seconds": round(dur, 4),
+            "ranges": 1,
+            "gb_per_sec": round(len(body) / max(dur, 1e-9) / 1e9, 3),
+        }
+        return state
+
     def shutdown(self, wait: bool = True) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self._blob is not None:
+            self._blob.close()
         if wait:
             self._thread.join(timeout=5)

@@ -50,6 +50,8 @@ __all__ = [
     "buffer_sizes",
     "ShardedArray",
     "from_transfer_tree",
+    "ArraySpec",
+    "spec_tree_from_header",
 ]
 
 
@@ -306,6 +308,46 @@ def unflatten_state(header: bytes, buffers: List[np.ndarray]) -> Any:
             leaves.append(
                 ShardedArray(np_dtype, shape, mesh_desc, spec_entries, shards)
             )
+        else:
+            leaves.append(pickle.loads(info[1]))
+    return _tree_util().tree_unflatten(treedef, leaves)
+
+
+class ArraySpec:
+    """jax-free shape/dtype spec leaf (the ``jax.ShapeDtypeStruct``
+    stand-in :func:`spec_tree_from_header` falls back to on hosts
+    without jax)."""
+
+    def __init__(self, shape: Tuple[int, ...], dtype: np.dtype) -> None:
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def __repr__(self) -> str:  # pragma: no cover — debug aid
+        return f"ArraySpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def spec_tree_from_header(header: bytes) -> Any:
+    """Rebuild the transferred pytree's SHAPE — ``jax.ShapeDtypeStruct``
+    leaves for arrays (global shape for sharded leaves), the actual
+    objects for ``obj`` leaves — from a transfer header alone. This is
+    what the heal/compile overlap consumes: the header arrives before any
+    bulk bytes, so a healer can start jit compilation from these specs
+    while the stripes stream (docs/heal_plane.md)."""
+    treedef, infos = pickle.loads(header)
+    try:
+        import jax
+
+        make = jax.ShapeDtypeStruct
+    except Exception:  # noqa: BLE001 — jax-free hosts get the plain spec
+        make = ArraySpec
+    leaves: List[Any] = []
+    for info in infos:
+        if info[0] == "arr":
+            _, dtype, shape, _ = info
+            leaves.append(make(tuple(shape), _resolve_dtype(dtype)))
+        elif info[0] == "shards":
+            _, dtype, shape = info[0], info[1], info[2]
+            leaves.append(make(tuple(shape), _resolve_dtype(dtype)))
         else:
             leaves.append(pickle.loads(info[1]))
     return _tree_util().tree_unflatten(treedef, leaves)

@@ -50,6 +50,12 @@ class QuorumResult:
     # quorum members' replica_ids in replica_rank order — lets the data
     # plane map a failed peer's ring rank to a replica_id for evict reports
     participant_ids: List[str] = field(default_factory=list)
+    # striped multi-source heal (docs/heal_plane.md): manager addresses of
+    # the whole max-step cohort (single bootstrap source at max_step == 0)
+    recover_src_addresses: List[str] = field(default_factory=list)
+    # someone heals this round — every up-to-date member stages a
+    # checkpoint so all of them can serve stripes
+    heal_pending: bool = False
 
     @staticmethod
     def _from_wire(d: Dict[str, Any]) -> "QuorumResult":
@@ -70,6 +76,11 @@ class QuorumResult:
                 s if isinstance(s, str) else s.decode()
                 for s in d.get("participant_ids", [])
             ],
+            recover_src_addresses=[
+                s if isinstance(s, str) else s.decode()
+                for s in d.get("recover_src_addresses", [])
+            ],
+            heal_pending=d.get("heal_pending", False),
         )
 
 

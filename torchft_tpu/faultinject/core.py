@@ -122,6 +122,7 @@ SITES = (
 # `python -m torchft_tpu.analysis` (wiredrift: fault-site-drift) keeps
 # this tuple and the native call sites from drifting apart.
 NATIVE_SITES = (
+    "blob.serve",
     "cma.desc",
     "cma.pull",
     "commit.vote",

@@ -200,6 +200,41 @@ SCENARIOS: List[Scenario] = [
         quick=False,
     ),
     Scenario(
+        name="stripe_heal_peer_death",
+        description="3 groups (custom runner): the victim g2 is "
+        "SIGKILLed mid-run and respawns into a striped multi-source heal "
+        "from the two survivors; survivor g1 is SIGKILLed by the native "
+        "blob plane on its first stripe serve (TORCHFT_FI_BLOB_KILL) — "
+        "the healer must re-stripe g1's pending ranges over g0 and "
+        "complete the heal (composing with the PR 4 ckpt_serve_death "
+        "retry), g1 respawns and heals striped itself, and all THREE "
+        "groups' final checksums must be finite and bit-identical",
+        victim_schedule={
+            "seed": 5,
+            "rules": [
+                {
+                    "site": "collective.issue",
+                    "match": "allreduce",
+                    "nth": 6,
+                    "action": "kill",
+                    "sig": 9,
+                }
+            ],
+        },
+        # forced tcp-striped on every group: a victim death on the CMA
+        # plane latches broken-CMA (TCP fallback) on SOME survivors only,
+        # and mixed planes mean mixed error-feedback enablement — the
+        # state TREES then legitimately differ and the digest check
+        # (correctly) excludes the odd source, defeating the scenario's
+        # two-source premise
+        common_env={"TORCHFT_DP_CMA": "0"},
+        # g1 = the stripe-serving survivor: its first blob range serve is
+        # during g2's re-heal (bootstrap heals are single-source from the
+        # sorted-first group, g0, so g1 serves nothing before the kill)
+        survivor_env={"TORCHFT_FI_BLOB_KILL": "1"},
+        expect_victim_death=True,
+    ),
+    Scenario(
         name="ckpt_serve_death",
         description="victim killed mid-run; the survivor's first "
         "checkpoint serve to the healer is cut mid-stream (serve death "
@@ -244,11 +279,12 @@ def _env_signature(text: str) -> Optional[str]:
 
 def _spawn(gid: int, lighthouse_addr: str, workdir: str, steps: int,
            env_extra: Dict[str, str],
-           argv: Optional[List[str]] = None) -> subprocess.Popen:
+           argv: Optional[List[str]] = None,
+           num_groups: int = 2) -> subprocess.Popen:
     env = dict(os.environ)
     env.update(
         REPLICA_GROUP_ID=str(gid),
-        NUM_REPLICA_GROUPS="2",
+        NUM_REPLICA_GROUPS=str(num_groups),
         STEPS=str(steps),
         BATCH="4",
         DATA_PATH=os.path.join(workdir, "corpus.bin"),
@@ -439,6 +475,174 @@ def run_scenario(scn: Scenario, workdir: str, steps: int = 16,
         )
     return Result(
         scn.name, "passed", f"checksums {sums[0]} == {sums[1]}",
+        fired=len(fired), respawns=respawns, checksums=sums,
+    )
+
+
+def run_stripe_heal_scenario(
+    scn: Scenario, workdir: str, steps: int = 16, timeout_s: float = 600.0,
+    extra_env: Optional[Dict[str, str]] = None,
+    worker_argv: Optional[List[str]] = None,
+) -> Result:
+    """The ``stripe_heal_peer_death`` scenario (ISSUE 9): THREE groups so
+    a striped heal has two sources to lose one of.
+
+    Roles: g0 runs clean; g2 (victim) is SIGKILLed mid-allreduce by its
+    schedule and respawned (scrubbed env) into a striped heal from
+    {g0, g1}; g1 carries ``TORCHFT_FI_BLOB_KILL=1`` — its first native
+    blob range serve (which is a stripe of g2's re-heal; bootstrap heals
+    are single-source from the sorted-first group g0) SIGKILLs it
+    mid-serve. The healer must re-stripe g1's pending ranges over g0 and
+    complete the heal; g1 is respawned and heals striped itself. PASS =
+    both deaths carry injection evidence, both victims respawned, and all
+    three groups exit 0 with finite, bit-identical final checksums.
+    Supports ``--sanitize`` (the jax-free numpy worker drives the same
+    refactored native stripe/blob layer).
+
+    The lighthouse runs ``min_replicas=3`` (all groups): with the default
+    2, the two survivors finish the whole run and EXIT while the
+    respawned victim is still booting (a few seconds of interpreter/jax
+    import), leaving it alone with an unformable quorum — gating quorum
+    formation on the full fleet keeps survivors parked (no commits)
+    during each absence, which is also the configuration under which the
+    striped heal deterministically has two sources."""
+    from torchft_tpu.coordination import LighthouseServer
+
+    os.makedirs(workdir, exist_ok=True)
+    evidence_dir = os.path.join(workdir, "evidence")
+    os.makedirs(evidence_dir, exist_ok=True)
+    with open(os.path.join(workdir, "corpus.bin"), "wb") as f:
+        f.write(bytes(range(256)) * 24)
+
+    def worker_env(gid: int, respawn: bool = False) -> Dict[str, str]:
+        env = dict(extra_env or {})
+        env.update(scn.common_env)
+        if gid == 1:
+            env.update(scn.survivor_env)
+            if scn.survivor_schedule is not None:
+                env["TORCHFT_FAULT_SCHEDULE"] = json.dumps(
+                    scn.survivor_schedule
+                )
+        elif gid == 2:
+            env.update(scn.victim_env)
+            if scn.victim_schedule is not None:
+                env["TORCHFT_FAULT_SCHEDULE"] = json.dumps(scn.victim_schedule)
+        if respawn:
+            env.pop("TORCHFT_FAULT_SCHEDULE", None)
+            for k in [k for k in env if k.startswith("TORCHFT_FI_")]:
+                env.pop(k)
+        return env
+
+    lighthouse = LighthouseServer(bind="[::]:0", min_replicas=3)
+    addr = lighthouse.address().split("//", 1)[-1]
+    procs = {
+        g: _spawn(g, addr, workdir, steps, worker_env(g), worker_argv,
+                  num_groups=3)
+        for g in (0, 1, 2)
+    }
+    respawns = 0
+    consumed_kill_pids: set = set()
+    deadline = time.monotonic() + timeout_s
+    try:
+        while True:
+            for gid, p in list(procs.items()):
+                if p.poll() is None or p.returncode == 0:
+                    continue
+                text = _read_log(workdir, gid)
+                kills = [
+                    r for r in read_evidence(evidence_dir)
+                    if r.get("action") == "kill"
+                    and r.get("pid") == p.pid
+                    and p.pid not in consumed_kill_pids
+                ]
+                if kills and respawns < 4:
+                    consumed_kill_pids.add(p.pid)
+                    respawns += 1
+                    procs[gid] = _spawn(
+                        gid, addr, workdir, steps,
+                        worker_env(gid, respawn=True), worker_argv,
+                        num_groups=3,
+                    )
+                elif _env_signature(text) \
+                        or p.returncode in CORRUPTION_SIGNAL_RCS:
+                    return Result(
+                        scn.name, "environmental",
+                        f"g{gid} rc={p.returncode} "
+                        f"sig={_env_signature(text)!r}",
+                        fired=len(read_evidence(evidence_dir)),
+                        respawns=respawns,
+                    )
+                else:
+                    return Result(
+                        scn.name, "failed",
+                        f"g{gid} rc={p.returncode} not explained by new "
+                        f"injection evidence; log tail: {text[-1500:]}",
+                        fired=len(read_evidence(evidence_dir)),
+                        respawns=respawns,
+                    )
+            if all(p.poll() is not None for p in procs.values()):
+                break
+            if time.monotonic() > deadline:
+                return Result(
+                    scn.name, "failed",
+                    f"timeout after {timeout_s}s (alive: "
+                    f"{sorted(g for g, p in procs.items() if p.poll() is None)})",
+                    respawns=respawns,
+                )
+            time.sleep(0.5)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        lighthouse.shutdown()
+
+    fired = read_evidence(evidence_dir)
+    blob_kills = [
+        r for r in fired
+        if r.get("action") == "kill" and r.get("site") == "blob.serve"
+    ]
+    sums = []
+    for gid in (0, 1, 2):
+        text = _read_log(workdir, gid)
+        m = re.findall(r"param_checksum=(-?[\d.]+|nan|inf)", text)
+        if not m:
+            return Result(
+                scn.name, "failed",
+                f"g{gid} exited 0 but printed no param_checksum; "
+                f"log tail: {text[-800:]}",
+                fired=len(fired), respawns=respawns,
+            )
+        sums.append(m[-1])
+    if any(s in ("nan", "inf") for s in sums):
+        return Result(
+            scn.name, "failed",
+            f"non-finite committed checksums {sums}",
+            fired=len(fired), respawns=respawns, checksums=sums,
+        )
+    if len(set(sums)) != 1:
+        return Result(
+            scn.name, "failed",
+            f"checksum divergence across 3 groups: {sums}",
+            fired=len(fired), respawns=respawns, checksums=sums,
+        )
+    if not blob_kills:
+        return Result(
+            scn.name, "failed",
+            "no blob.serve kill evidence — the stripe-serving survivor "
+            "was never killed mid-serve (heal too early/late?)",
+            fired=len(fired), respawns=respawns, checksums=sums,
+        )
+    if respawns < 2:
+        return Result(
+            scn.name, "failed",
+            f"expected BOTH the victim and the stripe-serving survivor "
+            f"to die+respawn; respawns={respawns}",
+            fired=len(fired), respawns=respawns, checksums=sums,
+        )
+    return Result(
+        scn.name, "passed",
+        f"3-way checksums identical ({sums[0]}); blob-serve kill + "
+        f"re-stripe survived",
         fired=len(fired), respawns=respawns, checksums=sums,
     )
 
@@ -751,6 +955,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             # fleet detector hosted by the runner process itself
             res = run_straggler_scenario(
                 scn, wd, steps=steps, timeout_s=args.timeout
+            )
+        elif scn.name == "stripe_heal_peer_death":
+            # custom 3-group runner: a striped heal needs two sources so
+            # one can die mid-serve (sanitize-capable — same worker argv)
+            res = run_stripe_heal_scenario(
+                scn, wd, steps=steps, timeout_s=args.timeout,
+                extra_env=extra_env, worker_argv=worker_argv,
             )
         else:
             res = run_scenario(scn, wd, steps=steps, timeout_s=args.timeout,

@@ -365,6 +365,25 @@ class Manager:
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
 
+        # Differential heal (docs/heal_plane.md, TORCHFT_HEAL_DIFF=1):
+        # a bounded per-leaf digest trail over recent committed steps.
+        # Recorded on the MAIN thread at each step's start_quorum (the
+        # state there is exactly the committed state at current_step);
+        # the serving side's delta endpoint and this replica's own heal
+        # request both read it. None when the feature is off — the
+        # per-step flatten+digest is not free.
+        from torchft_tpu.checkpointing import delta as _delta
+
+        self._heal_trail = _delta.CommitTrail() if _delta.diff_enabled() else None
+        if self._heal_trail is not None and hasattr(
+            self._checkpoint_transport, "commit_trail"
+        ):
+            self._checkpoint_transport.commit_trail = self._heal_trail
+        # heal-recv/compile overlap: a user-registered warmup callback,
+        # fired on a daemon thread with the incoming state's spec tree as
+        # soon as the transfer header is known (set_heal_warmup)
+        self._heal_warmup: Optional[Callable[[Any], None]] = None
+
         # Hang forensics (PR 2): SIGUSR2 dumps the collective flight
         # recorder (best-effort — only possible from the main thread), and
         # the step watchdog turns a silently wedged step into a
@@ -463,6 +482,128 @@ class Manager:
         self._load_state_dict = load_state_dict
         self._user_state_dict = state_dict
 
+    def set_heal_warmup(self, fn: Callable[[Any], None]) -> None:
+        """Register a warmup callback for the heal/compile overlap
+        (docs/heal_plane.md): during a heal, ``fn(spec_tree)`` runs on a
+        daemon thread as soon as the incoming state's header (dtypes +
+        shapes) is known — while the stripes are still streaming — so jit
+        compilation/warmup costs overlap the transfer instead of
+        serializing after it. ``spec_tree`` mirrors the state dict with
+        ``jax.ShapeDtypeStruct`` leaves. Best-effort: a failing warmup
+        never fails the heal."""
+        self._heal_warmup = fn
+
+    def _heal_header_cb(self, header: bytes) -> None:
+        """Transport header hook (runs on the quorum thread mid-recv):
+        kick the registered warmup off-thread so recv keeps streaming."""
+        fn = self._heal_warmup
+        if fn is None:
+            return
+
+        def run() -> None:
+            try:
+                from torchft_tpu.checkpointing.serialization import (
+                    spec_tree_from_header,
+                )
+
+                fn(spec_tree_from_header(header))
+            except Exception:  # noqa: BLE001 — warmup is best-effort
+                self._logger.exception("heal warmup failed")
+
+        threading.Thread(
+            target=run, daemon=True, name="tft_heal_warmup"
+        ).start()
+
+    def _record_commit_trail(self) -> None:
+        """Record the committed state's per-leaf digests at the current
+        step (main thread, step boundary — the state HERE is exactly the
+        state a heal at this step would serve). Idempotent per step; the
+        trail evicts past its horizon."""
+        assert self._heal_trail is not None
+        if self._user_state_dict is None:
+            return
+        try:
+            from torchft_tpu.checkpointing.serialization import flatten_state
+
+            _header, buffers = flatten_state(self._manager_state_dict())
+            self._heal_trail.record(self._step, buffers)
+        except Exception:  # noqa: BLE001 — the trail must never fail a step
+            self._logger.exception("commit-trail record failed")
+
+    def _heal_own_digest(self) -> Optional[tuple]:
+        """This replica's flattened state + tree digest at its last
+        committed step — the differential heal request's credentials.
+        None when differential heal can't apply (no state callbacks, step
+        0, feature off)."""
+        if (
+            self._heal_trail is None
+            or self._user_state_dict is None
+            or self._step <= 0
+        ):
+            return None
+        try:
+            from torchft_tpu.checkpointing import delta as _delta
+            from torchft_tpu.checkpointing.serialization import flatten_state
+
+            _header, buffers = flatten_state(self._manager_state_dict())
+            digests = _delta.leaf_digests(buffers)
+            return buffers, _delta.tree_digest(digests)
+        except Exception:  # noqa: BLE001 — degrade to a full heal
+            self._logger.exception("own-state digest failed")
+            return None
+
+    def _heal_sources(self, quorum) -> List[tuple]:
+        """Resolve the striped-heal source list: the lighthouse-named
+        primary first, then the rest of the max-step cohort, each mapped
+        to its checkpoint transport URL via ``mgr.checkpoint_metadata``.
+        A peer that fails the metadata RPC is dropped (it may be mid-death
+        — the stripe fetch would re-stripe around it anyway, this is just
+        cheaper). Returns ``[(manager_addr, transport_metadata), ...]``."""
+        from torchft_tpu.checkpointing.stripes import heal_sources_limit
+
+        addrs = [quorum.recover_src_manager_address]
+        for a in quorum.recover_src_addresses:
+            if a and a not in addrs:
+                addrs.append(a)
+        addrs = addrs[: heal_sources_limit()]
+        out: List[tuple] = []
+        lock = threading.Lock()
+
+        def resolve(addr: str) -> None:
+            try:
+                client = ManagerClient(
+                    addr, connect_timeout=self._connect_timeout
+                )
+                try:
+                    meta = client._checkpoint_metadata(
+                        self._rank, timeout=self._timeout
+                    )
+                finally:
+                    client.close()
+                with lock:
+                    out.append((addr, meta))
+            except Exception as e:  # noqa: BLE001 — drop the source
+                self._logger.warn(
+                    f"heal source {addr} metadata fetch failed: {e}"
+                )
+
+        if len(addrs) == 1:
+            resolve(addrs[0])
+        else:
+            threads = [
+                threading.Thread(
+                    target=resolve, args=(a,), name="tft_heal_meta"
+                )
+                for a in addrs
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        # keep the lighthouse-named primary first (deterministic plan)
+        out.sort(key=lambda t: addrs.index(t[0]))
+        return out
+
     def shutdown(self, wait: bool = True) -> None:
         """Shut down the manager, checkpoint transport and data plane."""
         self._shutting_down = True
@@ -529,6 +670,13 @@ class Manager:
             allow_heal=allow_heal,
             shrink_only=shrink_only,
         )
+        if self._heal_trail is not None:
+            # differential heal: digest the committed state at this step
+            # boundary (with a pipelined vote outstanding the user
+            # callback serves the rollback snapshot, which IS the
+            # committed state at current_step — same invariant the heal
+            # serve path relies on)
+            self._record_commit_trail()
 
         # Replace-under-lock, wait-outside-lock. Replacement only happens
         # after observing a DONE future under the lock, so a death-watch
@@ -728,7 +876,31 @@ class Manager:
                 self._sweep_stale_epochs(quorum.quorum_id)
 
         if allow_heal:
-            if quorum.recover_dst_ranks or quorum.heal:
+            from torchft_tpu.checkpointing.stripes import heal_sources_limit
+
+            # Striped multi-source heal (docs/heal_plane.md): when ANYONE
+            # heals this round, every max-step cohort member a healer may
+            # actually contact stages a checkpoint — not just the
+            # round-robin-assigned sources — so the healer can pull a
+            # stripe from each of them in parallel. Members past the
+            # healer-side source cap never get contacted for stripes, so
+            # they skip the flatten+stage (a 32-group fleet must not pay
+            # 31 full device-to-host copies for one rejoiner); a member
+            # that can't FIND itself in the cohort list stages
+            # conservatively (an address-format drift must degrade to
+            # wasted staging, never to an unserved healer).
+            _src_limit = heal_sources_limit()
+            stage_for_stripes = (
+                quorum.heal_pending
+                and not quorum.heal
+                and quorum.max_rank is not None
+                and _src_limit > 1
+                and (
+                    self._manager_addr in quorum.recover_src_addresses[:_src_limit]
+                    or self._manager_addr not in quorum.recover_src_addresses
+                )
+            )
+            if quorum.recover_dst_ranks or quorum.heal or stage_for_stripes:
                 # Pipelined commit: a speculative optimizer update may be
                 # outstanding on the main thread. Serving a checkpoint now
                 # would ship UNCOMMITTED state (and a veto would make the
@@ -736,9 +908,10 @@ class Manager:
                 # would race the rollback. Wait for the main thread to
                 # resolve the vote before any heal traffic.
                 self._await_speculation_settled()
-            if quorum.recover_dst_ranks:
+            if quorum.recover_dst_ranks or stage_for_stripes:
                 self._logger.info(
                     f"peers need recovery from us {quorum.recover_dst_ranks}"
+                    + (" (stripe source)" if stage_for_stripes else "")
                 )
                 with telemetry.TRACER.span(
                     "heal_send",
@@ -779,34 +952,51 @@ class Manager:
                     quorum.recover_src_rank is not None
                 ), "must have a recover rank when healing"
                 try:
-                    primary_client = ManagerClient(
-                        quorum.recover_src_manager_address,
-                        connect_timeout=self._connect_timeout,
-                    )
-                    try:
-                        checkpoint_metadata = primary_client._checkpoint_metadata(
-                            self._rank, timeout=self._timeout
+                    sources = self._heal_sources(quorum)
+                    if not sources:
+                        raise ConnectionError(
+                            "no heal source answered the checkpoint-"
+                            "metadata RPC"
                         )
-                    finally:
-                        primary_client.close()
-
+                    multi = getattr(
+                        self._checkpoint_transport,
+                        "recv_checkpoint_multi",
+                        None,
+                    )
                     # the user state dict is only applied from the main
                     # thread; stage it here
                     with telemetry.TRACER.span(
                         "heal_recv",
                         trace_id=self._trace_id(),
                         src=quorum.recover_src_manager_address,
+                        sources=len(sources),
                         step=quorum.max_step,
                     ):
-                        self._pending_state_dict = cast(
-                            Dict[str, object],
-                            self._checkpoint_transport.recv_checkpoint(
-                                src_rank=quorum.recover_src_rank,
-                                metadata=checkpoint_metadata,
-                                step=quorum.max_step,
-                                timeout=self._timeout,
-                            ),
-                        )
+                        if multi is not None:
+                            own = self._heal_own_digest()
+                            self._pending_state_dict = cast(
+                                Dict[str, object],
+                                multi(
+                                    [m for _, m in sources],
+                                    step=quorum.max_step,
+                                    timeout=self._timeout,
+                                    since_step=(
+                                        self._step if own is not None else None
+                                    ),
+                                    own=own,
+                                    header_cb=self._heal_header_cb,
+                                ),
+                            )
+                        else:
+                            self._pending_state_dict = cast(
+                                Dict[str, object],
+                                self._checkpoint_transport.recv_checkpoint(
+                                    src_rank=quorum.recover_src_rank,
+                                    metadata=sources[0][1],
+                                    step=quorum.max_step,
+                                    timeout=self._timeout,
+                                ),
+                            )
                 except Exception as e:  # noqa: BLE001 — heal must be retryable
                     # A torn/failed checkpoint transfer (the serving peer
                     # died mid-stream — fault-injection scenario
@@ -848,11 +1038,24 @@ class Manager:
                 telemetry.HEAL_DURATION.observe(heal_s)
                 self._last_heal_ts = _time.time()
                 self.step_timer.mark_heal()
+                # per-source stripe throughput + stage split from the
+                # multi-source transport (empty dict on legacy paths) —
+                # the recovery bench and the trail both read this, so a
+                # rejoin regression names its stage instead of one
+                # opaque duration
+                heal_stats = getattr(
+                    self._checkpoint_transport, "last_heal_stats", None
+                )
                 telemetry.emit(
                     "heal_end",
                     step=quorum.max_step,
                     bytes=nbytes,
                     duration_s=round(heal_s, 4),
+                    **(
+                        {"heal_stats": heal_stats}
+                        if isinstance(heal_stats, dict) and heal_stats
+                        else {}
+                    ),
                 )
 
     def _sweep_stale_epochs(self, current_qid: int) -> None:
@@ -892,10 +1095,14 @@ class Manager:
 
         t0 = _time.perf_counter()
         self._load_state_dict(cast(T, self._pending_state_dict["user"]))
+        dur = _time.perf_counter() - t0
         # step-anatomy `heal` phase: the main-thread share of a heal (the
         # staged-state apply; the transfer itself rides the quorum thread
-        # and shows as quorum_wait — docs/observability.md "Step anatomy")
-        telemetry.LEDGER.record("heal", _time.perf_counter() - t0)
+        # and shows as quorum_wait — docs/observability.md "Step anatomy").
+        # The same duration feeds the heal-stage view as `device_put` so
+        # the rejoin ledger (meta/recv/decode/device_put) is complete.
+        telemetry.LEDGER.record("heal", dur)
+        telemetry.LEDGER.record_heal_stage("device_put", dur)
         self._pending_state_dict = None
 
     # ------------------------------------------------------------------
@@ -946,6 +1153,14 @@ class Manager:
                 "collectives for the next step"
             )
         self.wait_quorum()
+        if self.errored():
+            # the quorum thread may have latched a failure DURING the wait
+            # (e.g. a failed heal transfer): the step is already doomed, and
+            # issuing the collective anyway would park this rank in a ring
+            # whose peers aborted — a full op-timeout of dead wait before
+            # the inevitable abort (observed in the stripe_heal_peer_death
+            # bring-up: +30s per step on the healer)
+            return Future.completed(tensors)
         # record which plane epoch this op rides: a death-watch re-quorum
         # can land MID-step, and a step whose ops span two epochs mixes
         # normalization denominators — should_commit vetoes those

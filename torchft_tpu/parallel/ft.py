@@ -61,6 +61,22 @@ class FTTrainer(SpeculativeCommitMixin):
         self._params = self._ts.init_params(rng)
         self._opt_state = self._ts.init_opt(self._params)
         self._manager.set_state_dict_fns(self.load_state_dict, self.state_dict)
+        if hasattr(self._manager, "set_heal_warmup"):
+            self._manager.set_heal_warmup(self._heal_warmup)
+
+    def _heal_warmup(self, spec_tree: Any) -> None:
+        """Heal/compile overlap (docs/heal_plane.md): runs on a daemon
+        thread as soon as the incoming checkpoint's header lands — AOT-
+        compile the apply step from the transferred shapes while the
+        stripes are still streaming, so the post-heal first step doesn't
+        serialize recv → compile."""
+        user = spec_tree.get("user") if isinstance(spec_tree, dict) else None
+        if not isinstance(user, dict):
+            return
+        params, opt_state = user.get("params"), user.get("opt_state")
+        if params is None or opt_state is None:
+            return
+        self._ts.warm_apply(params, opt_state)
 
     @property
     def params(self) -> Any:

@@ -81,6 +81,17 @@ class TrainStep:
         with jax.set_mesh(self.mesh):
             return jax.jit(self.tx.init)(params)
 
+    def warm_apply(self, params_spec, opt_state_spec) -> None:
+        """AOT-compile the donated ``apply`` jit from abstract specs (the
+        heal/compile overlap, docs/heal_plane.md): called on a background
+        thread while checkpoint stripes stream, so the healer's first
+        post-heal apply finds the executable warm (via the shared jit
+        lowering cache and/or the persistent XLA compilation cache)
+        instead of paying the compile serially after recv. Grad specs
+        mirror param specs (identical pytree/shapes/dtypes)."""
+        with jax.set_mesh(self.mesh):
+            self._apply.lower(params_spec, opt_state_spec, params_spec).compile()
+
     def shard_batch(self, tokens) -> jnp.ndarray:
         if not self._batch_sharding.is_fully_addressable:
             # multi-host group: every process holds the full batch (same

@@ -163,6 +163,12 @@ HEAL_DURATION = REGISTRY.histogram(
     "Wall-clock of a full heal (metadata fetch + checkpoint transfer + "
     "staging) on the healing side",
 )
+HEAL_STAGE_SECONDS = REGISTRY.counter(
+    "tft_heal_stage_seconds_total",
+    "Cumulative wall-clock inside the heal data path, by sub-stage "
+    "(meta / recv / decode / device_put — docs/heal_plane.md)",
+    labelnames=("stage",),
+)
 PEER_DEATHS = REGISTRY.counter(
     "tft_peer_deaths_total",
     "Dead-peer detections: death-watch socket EOF or a failed op naming "
@@ -307,6 +313,8 @@ for _reason in ("signal", "deadline", "watchdog", "manual"):
     FLIGHT_DUMPS.labels(reason=_reason)
 for _stage in ("host_copy", "quantize", "wire", "dequant_reduce"):
     WIRE_STAGE_SECONDS.labels(stage=_stage)
+for _stage in ("meta", "recv", "decode", "device_put"):
+    HEAL_STAGE_SECONDS.labels(stage=_stage)
 for _phase in PHASES:
     STEP_PHASE_SECONDS.labels(phase=_phase)
 for _slo in ("step_time", "rejoin_commit"):

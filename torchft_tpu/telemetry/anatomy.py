@@ -177,6 +177,7 @@ class StepLedger:
         self._totals: Dict[str, float] = {}        # row-eligible cumulative
         self._wire_totals: Dict[str, float] = {}   # record_wire_stage view
         self._wire_marks: Dict[str, float] = {}    # wire_stage_snapshot(reset)
+        self._heal_stages: Dict[str, float] = {}   # record_heal_stage view
         self._rows: Deque[Dict[str, Any]] = deque(maxlen=window)
         self.steps = 0
         self._timer = None  # profiling.StepTimer for the outlier digest
@@ -212,6 +213,32 @@ class StepLedger:
             from torchft_tpu import telemetry
 
             telemetry.WIRE_STAGE_SECONDS.labels(stage=phase).inc(seconds)
+
+    def record_heal_stage(self, stage: str, seconds: float) -> None:
+        """Accumulate a heal sub-stage (``meta``/``recv``/``decode``/
+        ``device_put`` — docs/heal_plane.md) into the cumulative heal-stage
+        view. Heals are rare, mostly ride the quorum thread, and span step
+        boundaries, so these do NOT enter step rows (the row's ``heal``
+        phase stays the main-thread apply, PR 8 semantics) — they exist so
+        a rejoin-to-commit regression is attributable to a stage instead
+        of a single opaque ``heal_end`` duration."""
+        if seconds <= 0.0:
+            return
+        with self._lock:
+            self._heal_stages[stage] = (
+                self._heal_stages.get(stage, 0.0) + seconds
+            )
+        try:
+            from torchft_tpu import telemetry
+
+            telemetry.HEAL_STAGE_SECONDS.labels(stage=stage).inc(seconds)
+        except Exception:  # noqa: BLE001 — observability never fails a heal
+            pass
+
+    def heal_stage_snapshot(self) -> Dict[str, float]:
+        """Process-cumulative seconds per heal sub-stage."""
+        with self._lock:
+            return {k: v for k, v in self._heal_stages.items() if v > 0.0}
 
     def attach_timer(self, timer: Any) -> None:
         """Attach the Manager's :class:`~torchft_tpu.profiling.StepTimer`
@@ -369,6 +396,11 @@ class StepLedger:
                     k: round(v, 6) for k, v in last["phases"].items()
                 },
             }
+        heal_stages = self.heal_stage_snapshot()
+        if heal_stages:
+            out["heal_stages"] = {
+                k: round(v, 6) for k, v in heal_stages.items()
+            }
         outliers = self.outlier_digest()
         if outliers:
             out["outliers"] = outliers[-8:]  # recent tail keeps it compact
@@ -400,6 +432,7 @@ class StepLedger:
             self._totals = {}
             self._wire_totals = {}
             self._wire_marks = {}
+            self._heal_stages = {}
             self._rows.clear()
             self.steps = 0
 
