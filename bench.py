@@ -544,6 +544,22 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         extra["quorum_overlap"] = {"error": str(e)}
 
+    # quorum fan-out p50/p99 vs group count (ISSUE 10 satellite — the
+    # measurement the ROADMAP HA open item names): N in-process manager
+    # servers against one lighthouse, read off the PR 8 native
+    # quorum.fanout latency histogram. Own process so the N-group
+    # lathist never contaminates this process's step-anatomy row.
+    try:
+        extra.update(
+            _run_json_subprocess(
+                [sys.executable, "-m", "torchft_tpu.benchmarks.quorum_scale"],
+                timeout_s=600,
+                env_extra={"JAX_PLATFORMS": "cpu"},
+            )
+        )
+    except Exception as e:  # noqa: BLE001
+        extra["quorum_scale"] = {"error": str(e)}
+
     # pipelined-vs-sync COMMIT barrier, same protocol as quorum_overlap:
     # 2 groups + a synthetic RTT on the should_commit RPC, interleaved
     # median-of-7 with spreads — the artifact behind commit_pipeline=True

@@ -1,0 +1,178 @@
+// Crash-durable native breadcrumbs — the C++ sibling of
+// torchft_tpu/telemetry/blackbox.py.
+//
+// The hot native paths (stripe hops, the RPC serve loop, quorum
+// transitions) run GIL-free and leave no trace when the process dies
+// mid-op — which is exactly when their last actions are the evidence a
+// postmortem needs. This header writes fixed-size records into an
+// mmap'd ring file: dirtied mmap pages belong to the kernel's page
+// cache, so a SIGKILL/SIGSEGV loses at most the one record being
+// written (its CRC won't validate — the reader skips it), never the
+// trail behind it.
+//
+// Lock-free by construction: one relaxed fetch_add claims a slot, the
+// record body is written, the CRC is stored last. Two writers can only
+// collide after a full ring lap mid-write, which the CRC again turns
+// into a skipped record instead of corrupt evidence. Disarmed
+// (TORCHFT_BLACKBOX_DIR unset), a record() call is one static load.
+//
+// File layout ("<dir>/tft_bb_<pid>_native.bb"):
+//   header (64 B): "TFTBBNA1" | u32 cap_records | u32 pid | pad
+//   records: cap_records x 64 B, slot = seq % cap
+//
+// Record (64 B, little-endian; torchft_tpu/telemetry/blackbox.py
+// read_native_blackbox() parses it byte for byte):
+//   u32 magic "NTBB" | u16 site | u16 flags | u64 seq | u64 ts_ns(wall)
+//   | i64 epoch | i64 step | i64 a | i64 b | u32 crc32(first 56 B)
+//   | u32 pad
+//
+// Ring bytes come from TORCHFT_BLACKBOX_SIZE (shared with the Python
+// ring; default 1 MiB => 16k records).
+
+#ifndef TFT_BLACKBOX_H_
+#define TFT_BLACKBOX_H_
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace tft {
+namespace bb {
+
+// Site ids are wire-stable: telemetry/blackbox.py NATIVE_SITES_BB maps
+// them back to names for the merged postmortem timeline.
+enum Site : uint16_t {
+  kDpHop = 1,
+  kDpStripe = 2,
+  kRpcServe = 3,
+  kQuorumPublish = 4,
+  kQuorumDeliver = 5,
+  kCommitDecision = 6,
+  kDivergence = 7,
+};
+
+constexpr uint32_t kRecMagic = 0x4242544E;  // "NTBB"
+constexpr size_t kRecSize = 64;
+constexpr size_t kHeaderSize = 64;
+
+// zlib-compatible CRC-32 (poly 0xEDB88320), table built once.
+inline uint32_t crc32(const uint8_t* data, size_t n) {
+  static const auto* table = [] {
+    auto* t = new uint32_t[256];
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+    return t;
+  }();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Ring {
+  uint8_t* base = nullptr;   // mmap base (nullptr = disarmed)
+  uint32_t cap = 0;          // record slots
+  std::atomic<uint64_t> seq{0};
+};
+
+inline Ring& ring() {
+  static Ring r;
+  static std::atomic<int> state{0};  // 0 = uninit, 1 = armed, -1 = off
+  int s = state.load(std::memory_order_acquire);
+  if (s != 0) return r;
+  // One-time init; a benign race here at worst re-runs the (idempotent)
+  // open on two threads — the loser's mapping leaks one ring, and both
+  // write valid records into whichever base wins the final store.
+  const char* dir = std::getenv("TORCHFT_BLACKBOX_DIR");
+  if (!dir || !*dir) {
+    state.store(-1, std::memory_order_release);
+    return r;
+  }
+  long bytes = 1 << 20;
+  if (const char* sz = std::getenv("TORCHFT_BLACKBOX_SIZE")) {
+    long v = std::atol(sz);
+    if (v >= 4096) bytes = v;
+  }
+  uint32_t cap = (uint32_t)((bytes - (long)kHeaderSize) / (long)kRecSize);
+  if (cap < 16) cap = 16;
+  size_t total = kHeaderSize + (size_t)cap * kRecSize;
+  char path[512];
+  std::snprintf(path, sizeof(path), "%s/tft_bb_%d_native.bb", dir,
+                (int)getpid());
+  int fd = ::open(path, O_RDWR | O_CREAT, 0644);
+  if (fd < 0) {
+    state.store(-1, std::memory_order_release);
+    return r;
+  }
+  if (ftruncate(fd, (off_t)total) != 0) {
+    ::close(fd);
+    state.store(-1, std::memory_order_release);
+    return r;
+  }
+  void* m = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (m == MAP_FAILED) {
+    state.store(-1, std::memory_order_release);
+    return r;
+  }
+  uint8_t* b = (uint8_t*)m;
+  std::memset(b, 0, kHeaderSize);
+  std::memcpy(b, "TFTBBNA1", 8);
+  uint32_t pid = (uint32_t)getpid();
+  std::memcpy(b + 8, &cap, 4);
+  std::memcpy(b + 12, &pid, 4);
+  r.cap = cap;
+  r.base = b;
+  state.store(1, std::memory_order_release);
+  return r;
+}
+
+inline void record(Site site, int64_t epoch, int64_t step, int64_t a,
+                   int64_t b) {
+  Ring& r = ring();
+  if (r.base == nullptr) return;
+  uint64_t seq = r.seq.fetch_add(1, std::memory_order_relaxed) + 1;
+  uint8_t* slot = r.base + kHeaderSize + (size_t)(seq % r.cap) * kRecSize;
+  uint64_t ts_ns = (uint64_t)std::chrono::duration_cast<
+                       std::chrono::nanoseconds>(
+                       std::chrono::system_clock::now().time_since_epoch())
+                       .count();
+  uint8_t rec[kRecSize];
+  std::memset(rec, 0, sizeof(rec));
+  uint32_t magic = kRecMagic;
+  uint16_t s16 = (uint16_t)site;
+  uint16_t flags = 0;
+  std::memcpy(rec + 0, &magic, 4);
+  std::memcpy(rec + 4, &s16, 2);
+  std::memcpy(rec + 6, &flags, 2);
+  std::memcpy(rec + 8, &seq, 8);
+  std::memcpy(rec + 16, &ts_ns, 8);
+  std::memcpy(rec + 24, &epoch, 8);
+  std::memcpy(rec + 32, &step, 8);
+  std::memcpy(rec + 40, &a, 8);
+  std::memcpy(rec + 48, &b, 8);
+  uint32_t crc = crc32(rec, 56);
+  std::memcpy(rec + 56, &crc, 4);
+  // Invalidate the slot's old CRC first, then body, CRC last: a reader
+  // (post-mortem, different process) can never validate a half-written
+  // record, and a crash mid-copy leaves a CRC-failing slot — one lost
+  // record, never corrupt evidence.
+  std::memset(slot + 56, 0, 8);
+  std::memcpy(slot, rec, 56);
+  std::memcpy(slot + 56, rec + 56, 8);
+}
+
+}  // namespace bb
+}  // namespace tft
+
+#endif  // TFT_BLACKBOX_H_

@@ -8,6 +8,7 @@
 #include <cstdlib>
 #include <sstream>
 
+#include "blackbox.h"     // crash-durable quorum/commit breadcrumbs
 #include "faultinject.h"  // env-gated injection points (reply delay/drop)
 #include "lathist.h"      // quorum.fanout latency histogram + exports
 
@@ -403,6 +404,10 @@ void Lighthouse::quorum_tick() {
 
   published_[++quorum_seq_] = q;
   while (published_.size() > 16) published_.erase(published_.begin());
+  // crash-durable quorum-transition breadcrumb (a = participants,
+  // b = flush): the epoch history survives a lighthouse death
+  bb::record(bb::kQuorumPublish, state_.quorum_id, -1,
+             (int64_t)q.participants.size(), flush ? 1 : 0);
   cv_.notify_all();
 }
 
@@ -417,7 +422,111 @@ Value Lighthouse::handle_rpc(const std::string& method, const Value& req,
     return Value::M();
   }
   if (method == "lh.evict") return handle_evict(req);
+  if (method == "lh.digest") return handle_digest(req, deadline);
   throw RpcError(INVALID_ARGUMENT, "unknown method " + method);
+}
+
+Value Lighthouse::handle_digest(const Value& req, int64_t deadline) {
+  // Divergence sentinel (ISSUE 10): every committed step's post-reduce
+  // state is bit-identical across the cohort BY CONSTRUCTION (the
+  // allgather forwards owner bytes verbatim — docs/wire_plane.md), so a
+  // digest mismatch within one (epoch, step) round is the corrupt-commit
+  // failure mode itself: a mid-op peer death or torn read that slipped
+  // into an average. Latch it here, at the commit boundary, instead of
+  // noticing the loss going nan thousands of steps later.
+  const std::string replica = req.gets("replica_id");
+  const std::string digest = req.gets("digest");
+  const int64_t epoch = req.geti("epoch", -1);
+  const int64_t step = req.geti("step", -1);
+  const bool wait = req.getb("wait", false);
+  const int64_t cohort_hint = req.geti("cohort", 0);
+  if (replica.empty() || digest.empty())
+    throw RpcError(INVALID_ARGUMENT, "digest: missing replica_id/digest");
+
+  std::unique_lock<std::mutex> lk(mu_);
+  const auto key = std::make_pair(epoch, step);
+  digest_rounds_[key].digests[replica] = digest;
+  // bound the store; never evict the round being served
+  while (digest_rounds_.size() > 8 && digest_rounds_.begin()->first != key)
+    digest_rounds_.erase(digest_rounds_.begin());
+
+  auto check_round = [&](DigestRound& round) {
+    // "-" is the abstain marker: a group whose step aborts locally (a
+    // torn op means its digest covers fewer reduces) still reports —
+    // completing the fence's cohort wait — but never enters the
+    // comparison: only COMMITTING states must agree.
+    std::map<std::string, int> freq;
+    for (const auto& [id, d] : round.digests)
+      if (d != "-") freq[d]++;
+    if (freq.size() <= 1) return;
+    const bool first_latch = !round.diverged;
+    round.diverged = true;
+    divergence_detected_ = true;
+    if (first_latch) divergence_total_++;  // one incident per round
+    // minority replicas go red on the dashboard; a 1-vs-1 split names
+    // both — the postmortem assigns blame, the sentinel only latches.
+    // Re-evaluated on every report so a LATE reporter with yet another
+    // digest (3-group fleets) is still attributed, not just the pair
+    // that tripped the first latch.
+    int majority = 0;
+    for (const auto& [d, n] : freq) majority = std::max(majority, n);
+    std::ostringstream detail;
+    detail << "epoch " << epoch << " step " << step << ":";
+    for (const auto& [id, d] : round.digests) {
+      if (d != "-" && (freq[d] < majority || majority == 1))
+        diverged_replicas_.insert(id);
+      detail << " " << id << "=" << d.substr(0, 16);
+    }
+    last_divergence_ = detail.str();
+    if (first_latch) {
+      bb::record(bb::kDivergence, epoch, step,
+                 (int64_t)round.digests.size(), (int64_t)freq.size());
+      logline("DIVERGENCE detected at " + last_divergence_);
+    }
+  };
+  check_round(digest_rounds_[key]);
+  cv_.notify_all();
+
+  if (wait) {
+    // fence path: block until the full cohort reported (or the round
+    // already diverged — no point waiting to learn more). Cohort size
+    // is the current quorum; a caller outside any quorum must pass the
+    // explicit `cohort` hint (unit tests).
+    size_t cohort = cohort_hint > 0
+                        ? (size_t)cohort_hint
+                        : (state_.prev_quorum.has_value()
+                               ? state_.prev_quorum->participants.size()
+                               : 1);
+    bool ok = cv_wait_deadline(cv_, lk, deadline, [&] {
+      if (!running_.load()) return true;
+      auto it = digest_rounds_.find(key);
+      return it == digest_rounds_.end() ||
+             it->second.digests.size() >= cohort || it->second.diverged;
+    });
+    if (!running_.load())
+      throw RpcError(CANCELLED, "lighthouse shutting down");
+    if (!ok)
+      throw RpcError(DEADLINE_EXCEEDED,
+                     "digest cohort wait timed out (a fleet must opt "
+                     "every group into the fence)");
+  }
+  auto it = digest_rounds_.find(key);
+  bool diverged_round = it != digest_rounds_.end() && it->second.diverged;
+  int64_t reports =
+      it != digest_rounds_.end() ? (int64_t)it->second.digests.size() : 0;
+  if (diverged_round) {
+    // retire the round once every reporter has its veto: an aborted
+    // step RETRIES under the same (epoch, step), and a sticky per-round
+    // verdict would veto the clean retry forever (observed as a fence
+    // livelock in the corrupt_divergence scenario bring-up)
+    if (++it->second.answered >= (int)it->second.digests.size())
+      digest_rounds_.erase(it);
+  }
+  Value out = Value::M();
+  out.set("match", Value::B(!diverged_round));
+  out.set("divergence", Value::B(divergence_detected_));
+  out.set("reports", Value::I(reports));
+  return out;
 }
 
 void Lighthouse::ingest_telemetry(const std::string& replica_id,
@@ -698,7 +807,7 @@ std::string Lighthouse::status_html() {
     o << "<h2>Replica health</h2><table border=1 cellpadding=4>"
          "<tr><th>replica_id</th><th>last report</th><th>step</th>"
          "<th>last heal</th><th>local p50</th><th>stuck</th>"
-         "<th>SLO</th></tr>";
+         "<th>SLO</th><th>digest</th></tr>";
     // two clocks on purpose: report ages use the monotonic clock that
     // stamped last_ms (mixing in wall time would show epoch-offset
     // garbage), while last_heal_ts is a unix timestamp from the replica
@@ -718,13 +827,23 @@ std::string Lighthouse::status_html() {
         // the burn-rate SLO column (ISSUE 8): red next to the PR 2 STUCK
         // flag, driven by the replica-side evaluator's piggybacked latch
         << "</td><td" << (t.slo_breach ? " style=\"background:red\"" : "")
-        << ">" << (t.slo_breach ? "BREACH" : "ok") << "</td></tr>";
+        << ">" << (t.slo_breach ? "BREACH" : "ok")
+        // divergence-sentinel column (ISSUE 10): red when this replica's
+        // commit-time state digest was in a diverged cohort round
+        << "</td><td"
+        << (diverged_replicas_.count(id) ? " style=\"background:red\"" : "")
+        << ">" << (diverged_replicas_.count(id) ? "DIVERGED" : "ok")
+        << "</td></tr>";
     }
     o << "</table><p><a href=\"/cluster.json\">cluster.json</a> | "
          "<a href=\"/trace\">merged trace (open in Perfetto)</a></p>";
   }
   o << "<h2>FT events</h2><p>evictions: " << evictions_total_
-    << " | data-plane flush re-quorums: " << flush_requests_total_ << "</p>";
+    << " | data-plane flush re-quorums: " << flush_requests_total_
+    << " | divergence incidents: " << divergence_total_ << "</p>";
+  if (divergence_detected_)
+    o << "<p style=\"background:red\">DIVERGENCE latched: "
+      << html_escape(last_divergence_) << "</p>";
   if (!recent_evictions_.empty()) {
     o << "<table border=1 cellpadding=4><tr><th>recent evictions "
          "(victim &lt; reporter @ unix s)</th></tr>";
@@ -745,7 +864,12 @@ std::string Lighthouse::cluster_json() {
   int64_t now = now_ms();  // monotonic: ages only, never absolute times
   std::ostringstream o;
   o << "{\"now_unix_ms\":" << wall_ms() << ",\"quorum_id\":"
-    << state_.quorum_id << ",\"replicas\":{";
+    << state_.quorum_id
+    // divergence-sentinel latch (ISSUE 10): fleet-level, so one scrape
+    // answers "did any committed step's state ever disagree"
+    << ",\"divergence_detected\":"
+    << (divergence_detected_ ? "true" : "false")
+    << ",\"divergence_total\":" << divergence_total_ << ",\"replicas\":{";
   bool first = true;
   for (const auto& [id, t] : telemetry_) {
     if (!first) o << ",";
@@ -762,6 +886,8 @@ std::string Lighthouse::cluster_json() {
       << ",\"last_heal_ts\":" << heal_ts
       << ",\"local_step_p50_s\":" << p50
       << ",\"slo_breach\":" << (t.slo_breach ? "true" : "false")
+      << ",\"diverged\":"
+      << (diverged_replicas_.count(id) ? "true" : "false")
       << ",\"summary\":"
       << (t.summary_json.empty() ? "{}" : t.summary_json)
       << ",\"anatomy\":"
@@ -855,7 +981,12 @@ std::string Lighthouse::handle_http(const std::string& method,
     o << "# TYPE torchft_evictions_total counter\n"
       << "torchft_evictions_total " << evictions_total_ << "\n"
       << "# TYPE torchft_flush_requests_total counter\n"
-      << "torchft_flush_requests_total " << flush_requests_total_ << "\n";
+      << "torchft_flush_requests_total " << flush_requests_total_ << "\n"
+      << "# TYPE torchft_divergence_total counter\n"
+      << "torchft_divergence_total " << divergence_total_ << "\n"
+      << "# TYPE torchft_divergence_detected gauge\n"
+      << "torchft_divergence_detected " << (divergence_detected_ ? 1 : 0)
+      << "\n";
     o << "# TYPE torchft_heartbeat_age_seconds gauge\n";
     for (const auto& [id, beat] : state_.heartbeats)
       o << "torchft_heartbeat_age_seconds{replica_id=\"" << prom_escape(id)
@@ -887,7 +1018,10 @@ std::string Lighthouse::handle_http(const std::string& method,
                              : -1)
       << ",\"heartbeats\":" << state_.heartbeats.size()
       << ",\"evictions_total\":" << evictions_total_
-      << ",\"flush_requests_total\":" << flush_requests_total_;
+      << ",\"flush_requests_total\":" << flush_requests_total_
+      << ",\"divergence_total\":" << divergence_total_
+      << ",\"divergence_detected\":"
+      << (divergence_detected_ ? "true" : "false");
     if (state_.prev_quorum) {
       int64_t mstep = -1;
       for (const auto& p : state_.prev_quorum->participants)
@@ -975,6 +1109,8 @@ ManagerSrv::ManagerSrv(const std::string& replica_id,
   // matching Manager::new (src/manager.rs:97).
   lighthouse_client_ =
       std::make_unique<RpcClient>(lighthouse_addr, connect_timeout_ms);
+  digest_client_ =
+      std::make_unique<RpcClient>(lighthouse_addr, connect_timeout_ms);
   std::string err;
   bool ok = server_.start(
       bind,
@@ -992,8 +1128,10 @@ ManagerSrv::~ManagerSrv() { shutdown(); }
 void ManagerSrv::shutdown() {
   if (!running_.exchange(false)) return;
   // A handler may be blocked inside the lighthouse long-poll holding mu_;
-  // abort the socket first so it fails fast and releases the lock.
+  // abort the socket first so it fails fast and releases the lock. Same
+  // for a digest fence wait blocked on the lighthouse cohort.
   lighthouse_client_->abort();
+  digest_client_->abort();
   {
     std::lock_guard<std::mutex> g(mu_);
     cv_.notify_all();
@@ -1173,6 +1311,10 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
                    "16 quorum rounds; re-join with a fresh quorum call");
   }
   ManagerQuorumResult res = compute_quorum_results(replica_id_, rank, it->second);
+  // crash-durable breadcrumb: the last quorum this rank was delivered
+  // (a = rank, b = heal) — pairs with the lighthouse's publish records
+  bb::record(bb::kQuorumDeliver, res.quorum_id, res.max_step, rank,
+             res.heal ? 1 : 0);
   // env-gated injection: hold the computed quorum reply (outside the
   // lock — peer ranks' handlers must not stall behind the injected delay)
   static const long fi_qd =
@@ -1186,22 +1328,95 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
 
 Value ManagerSrv::handle_should_commit(const Value& req, int64_t deadline) {
   int64_t rank = req.geti("rank");
+  int64_t step = req.geti("step", -1);
   bool vote = req.getb("should_commit");
 
   std::unique_lock<std::mutex> lk(mu_);
   if (!vote) commit_failures_.insert(rank);
   commit_votes_.insert(rank);
+  // Divergence sentinel (ISSUE 10): each local rank may attach a digest
+  // of its post-reduce state; the round-completing rank folds them (in
+  // rank order — cross-group comparison is per rank plane) into one
+  // group digest and reports it to the lighthouse's (epoch, step)
+  // cohort compare. `fence` asks the lighthouse to arbitrate BEFORE the
+  // decision publishes, closing the corrupt-commit hole at the source.
+  if (req.has("digest")) {
+    commit_digests_[rank] = req.gets("digest");
+    commit_epoch_ = req.geti("epoch", commit_epoch_);
+    commit_fence_ = commit_fence_ || req.getb("fence", false);
+  }
   uint64_t seen = commit_seq_;
 
   if (commit_votes_.size() >= world_size_) {
     bool decision = commit_failures_.empty();
-    logline("should_commit completed decision=" +
-            std::string(decision ? "true" : "false"));
-    commit_decisions_[++commit_seq_] = decision;
-    while (commit_decisions_.size() > 16)
-      commit_decisions_.erase(commit_decisions_.begin());
+    bool divergence = false;
+    // Consume the round's state BEFORE any unlock: a retrying rank's
+    // vote landing while the digest exchange is in flight must start a
+    // FRESH round (park below at < world_size votes), never observe the
+    // still-full vote set and publish a duplicate decision.
+    bool any_abstain = false;
+    std::string group;
+    for (const auto& [r, d] : commit_digests_) {
+      if (d == "-") any_abstain = true;
+      group += std::to_string(r) + ":" + d + ";";
+    }
+    const bool have_digests = !commit_digests_.empty();
+    // one abstaining rank abstains the whole group (its plane's state
+    // is not committing cleanly)
+    if (any_abstain) group = "-";
+    const bool fence = commit_fence_;
+    const int64_t ep = commit_epoch_;
+    commit_digests_.clear();
+    commit_fence_ = false;
     commit_votes_.clear();
     commit_failures_.clear();
+    if (have_digests) {
+      // Lighthouse exchange OUTSIDE the lock (every local rank of THIS
+      // round has voted and is parked in the cv wait below; the round's
+      // own state was consumed above). Report even on a local veto: the
+      // other groups' fence waits gate on the FULL cohort, and a silent
+      // absence would stretch their commit to the deadline for a step
+      // that aborts anyway.
+      lk.unlock();
+      bool match = true;
+      try {
+        Value dreq = Value::M();
+        dreq.set("replica_id", Value::S(replica_id_));
+        dreq.set("epoch", Value::I(ep));
+        dreq.set("step", Value::I(step));
+        dreq.set("digest", Value::S(group));
+        dreq.set("wait", Value::B(fence));
+        int64_t to_ms =
+            fence ? std::max((int64_t)1000, deadline - now_ms()) : 5000;
+        Value dresp = digest_client_->call("lh.digest", dreq, to_ms);
+        match = dresp.getb("match", true);
+        divergence = dresp.getb("divergence", false);
+      } catch (const std::exception& e) {
+        // best-effort when the lighthouse can't answer: fail OPEN — a
+        // missing compare cannot corrupt state, and quorum formation
+        // (which also needs the lighthouse) is the real gate on
+        // progress. The fence only vetoes on a POSITIVE mismatch.
+        logline(std::string("divergence digest exchange failed: ") +
+                e.what());
+      }
+      lk.lock();
+      if (fence && !match) {
+        logline("DIVERGENCE FENCE: vetoing commit at step " +
+                std::to_string(step));
+        decision = false;
+        divergence = true;
+      }
+    }
+    logline("should_commit completed decision=" +
+            std::string(decision ? "true" : "false"));
+    bb::record(bb::kCommitDecision, ep, step, decision ? 1 : 0,
+               divergence ? 1 : 0);
+    commit_decisions_[++commit_seq_] = decision;
+    commit_divergence_[commit_seq_] = divergence;
+    while (commit_decisions_.size() > 16)
+      commit_decisions_.erase(commit_decisions_.begin());
+    while (commit_divergence_.size() > 16)
+      commit_divergence_.erase(commit_divergence_.begin());
     cv_.notify_all();
   }
 
@@ -1223,6 +1438,9 @@ Value ManagerSrv::handle_should_commit(const Value& req, int64_t deadline) {
                    "trimmed; treat the step as failed and re-quorum");
   }
   const bool decision = it->second;
+  auto dit = commit_divergence_.find(seen + 1);
+  const bool divergence_flag =
+      dit != commit_divergence_.end() && dit->second;
   lk.unlock();
   // env-gated injection on the vote DECISION path: delay the reply
   // (commit-barrier RTT) or drop the nth one (a lost decision — the
@@ -1239,7 +1457,9 @@ Value ManagerSrv::handle_should_commit(const Value& req, int64_t deadline) {
       throw RpcError(UNAVAILABLE, "fault injection: dropped commit reply");
     }
   }
-  return Value::M().set("should_commit", Value::B(decision));
+  return Value::M()
+      .set("should_commit", Value::B(decision))
+      .set("divergence", Value::B(divergence_flag));
 }
 
 // ---- KV store -------------------------------------------------------------

@@ -180,6 +180,11 @@ class Lighthouse {
                    int64_t deadline);
   Value handle_quorum(const Value& req, int64_t deadline);
   Value handle_evict(const Value& req);
+  // Divergence sentinel (lh.digest): record one replica's commit-time
+  // state digest for its (epoch, step) cohort, compare within the
+  // cohort, latch on mismatch; wait=true long-polls until the full
+  // cohort reported (the fence path).
+  Value handle_digest(const Value& req, int64_t deadline);
   std::string handle_http(const std::string& method, const std::string& path);
   void tick_loop();
   // Must hold mu_. Runs one quorum evaluation and publishes if met.
@@ -208,6 +213,26 @@ class Lighthouse {
   // Cluster telemetry aggregation (PR 2): per-replica rolling store fed by
   // piggybacked reports, served at /cluster.json and merged at /trace.
   std::map<std::string, ReplicaTelemetry> telemetry_;
+  // Divergence sentinel (ISSUE 10): commit-time digest rounds keyed by
+  // (epoch, step). Every committed step's post-reduce state is
+  // bit-identical across the cohort by construction, so two distinct
+  // digests in one round IS the corrupt-commit failure mode — latch it
+  // before nan propagates. Bounded to the last few rounds.
+  struct DigestRound {
+    std::map<std::string, std::string> digests;  // replica_id -> digest
+    bool diverged = false;
+    // replies delivered for a diverged round: once every reporter has
+    // been answered (vetoed), the round retires so the RETRY of the
+    // same (epoch, step) — commit aborts don't advance the step —
+    // compares fresh digests instead of inheriting the stale verdict
+    // (the global latch/counter persist; only the round resets).
+    int answered = 0;
+  };
+  std::map<std::pair<int64_t, int64_t>, DigestRound> digest_rounds_;
+  bool divergence_detected_ = false;   // global latch (never clears)
+  int64_t divergence_total_ = 0;       // rounds that diverged
+  std::string last_divergence_;        // human-readable incident detail
+  std::set<std::string> diverged_replicas_;  // red dashboard column
 
   std::atomic<bool> running_{true};
   std::thread tick_thread_;
@@ -245,6 +270,15 @@ class ManagerSrv {
   RpcServer server_;
   std::unique_ptr<RpcClient> lighthouse_client_;  // for quorum calls
 
+  // Divergence sentinel: one lh.digest round trip per commit when armed
+  // — a per-step hot path, so keep a persistent dedicated connection
+  // (the shared lighthouse_client_ may be parked in a long-poll quorum
+  // call; reconnecting per commit would pay a TCP handshake every
+  // step). Created eagerly so the pointer is immutable and shutdown can
+  // abort a blocked fence wait; RpcClient itself serializes concurrent
+  // calls and reconnects after failures/aborts.
+  std::unique_ptr<RpcClient> digest_client_;
+
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<int64_t, std::string> checkpoint_metadata_;
@@ -263,6 +297,14 @@ class ManagerSrv {
   std::set<int64_t> commit_failures_;
   uint64_t commit_seq_ = 0;
   std::map<uint64_t, bool> commit_decisions_;
+  // Divergence sentinel: this round's per-rank state digests (folded in
+  // rank order into one group digest and reported to the lighthouse by
+  // the round-completing rank), the round's fence request, and the
+  // per-decision divergence flag echoed to every local rank.
+  std::map<int64_t, std::string> commit_digests_;
+  bool commit_fence_ = false;
+  int64_t commit_epoch_ = -1;
+  std::map<uint64_t, bool> commit_divergence_;
 
   std::atomic<bool> running_{true};
   std::thread heartbeat_thread_;

@@ -18,6 +18,7 @@
 #include <limits>
 #include <stdexcept>
 
+#include "blackbox.h"     // crash-durable dp.hop / dp.stripe breadcrumbs
 #include "faultinject.h"  // env-gated injection points (torn hops, kills)
 #include "lathist.h"      // dp.hop / dp.stripe latency histograms
 #include "rpc.h"  // tcp_listen / tcp_connect / listen_port / now_ms
@@ -727,8 +728,12 @@ int DataPlane::run_stripe(int stripe_idx, Job& job, int* bad_peer,
                                 job.deadline_ms, &send_failed, &timed_out, err)
                       : hop(send_fd, recv_fd, sb, sn, rb, rn, job.tag,
                             job.deadline_ms, &send_failed, &timed_out, err);
-    lathist::observe(lathist::kDpHop,
-                     (double)(lathist::now_ns() - t0) / 1e9);
+    int64_t hop_ns = lathist::now_ns() - t0;
+    lathist::observe(lathist::kDpHop, (double)hop_ns / 1e9);
+    // crash-durable breadcrumb: a worker SIGKILLed mid-allreduce leaves
+    // its last hops (a = op tag, b = ok flag) in the black box — the
+    // postmortem's "what was in flight" answer for the native plane
+    bb::record(bb::kDpHop, -1, -1, (int64_t)job.tag, ok ? 1 : 0);
     return ok;
   };
   // a deadline or LOCAL shutdown names NO peer: slow-but-alive (or our
@@ -860,6 +865,9 @@ void DataPlane::worker_loop(int stripe_idx) {
       rc = run_stripe(stripe_idx, job, &bad_peer, &err);
       lathist::observe(lathist::kDpStripe,
                        (double)(lathist::now_ns() - t0) / 1e9);
+      // stripe-level breadcrumb (a = op tag, b = rc): pairs with the
+      // per-hop records to name the exact stripe a death interrupted
+      bb::record(bb::kDpStripe, -1, -1, (int64_t)job.tag, rc);
     }
     {
       std::lock_guard<std::mutex> g(st.mu);
@@ -973,7 +981,9 @@ extern "C" {
 // v4: tft_blob_* striped checkpoint blob plane added (blob.cc)).
 // The Python loader (_native/__init__.py) refuses to run a mismatched
 // build and rebuilds in place.
-int tft_abi_version() { return 4; }
+// v5: mgr.should_commit carries divergence-sentinel digests, lh.digest
+// RPC added, native blackbox breadcrumbs (blackbox.h) compiled in.
+int tft_abi_version() { return 5; }
 
 int64_t tft_dp_create(int rank, int world, int nstripes, char* err,
                       int errlen) {

@@ -14,6 +14,7 @@
 #include <cstring>
 #include <random>
 
+#include "blackbox.h"     // crash-durable rpc.serve breadcrumbs
 #include "faultinject.h"  // env-gated injection points (torn frames, delays)
 #include "lathist.h"      // rpc.serve latency histogram
 
@@ -386,8 +387,11 @@ void RpcServer::serve_conn(int fd) {
       resp.set("_s", Value::I(INTERNAL));
       resp.set("_e", Value::S(e.what()));
     }
-    lathist::observe(lathist::kRpcServe,
-                     (double)(lathist::now_ns() - serve_t0) / 1e9);
+    int64_t serve_ns = lathist::now_ns() - serve_t0;
+    lathist::observe(lathist::kRpcServe, (double)serve_ns / 1e9);
+    // crash-durable breadcrumb: the last RPCs a dying server handled
+    // (a = status code, b = serve ns) survive a SIGKILL mid-serve
+    bb::record(bb::kRpcServe, -1, -1, resp.geti("_s", OK), serve_ns);
     std::string body = encode(resp);
     uint8_t out[4] = {(uint8_t)(body.size() & 0xff),
                       (uint8_t)((body.size() >> 8) & 0xff),

@@ -106,7 +106,28 @@ def skip_if_known_corruption(
         # data plane silently poisoned the averages on every worker
         sig = "param_checksum=nan"
     if sig is not None:
+        # Triaged artifact instead of a bare skip (ISSUE 10): when the
+        # soak ran with black boxes armed, reconstruct the incident and
+        # record the postmortem classification next to the evidence —
+        # an environmental-churn skip then leaves a timeline naming the
+        # victim and its in-flight op, not just a signature string.
+        pm = ""
+        try:
+            import json
+
+            bb_dir = os.environ.get("TORCHFT_BLACKBOX_DIR") or evidence_dir
+            if bb_dir and os.path.isdir(bb_dir):
+                from torchft_tpu.telemetry import postmortem
+
+                report = postmortem.analyze(bb_dir, log_text=text)
+                out_dir = evidence_dir or bb_dir
+                out = os.path.join(out_dir, "postmortem_skip.json")
+                with open(out, "w", encoding="utf-8") as f:
+                    json.dump(report, f, indent=1, default=str)
+                pm = f"; postmortem={report['classification']} -> {out}"
+        except Exception:  # noqa: BLE001 — forensics must not fail the skip
+            pm = ""
         pytest.skip(
-            f"known pre-existing native corruption in a worker ({sig!r}); "
-            "see ROADMAP open items"
+            f"known pre-existing native corruption in a worker ({sig!r})"
+            f"{pm}; see ROADMAP open items"
         )

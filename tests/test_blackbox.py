@@ -1,0 +1,336 @@
+"""Crash-durable black box + fleet postmortem tests (ISSUE 10).
+
+The contract under test: everything written to the mmap'd ring before a
+process death — including SIGKILL mid-write — is recoverable, a torn
+tail is *skipped* (CRC) and never surfaces as a corrupt record, and the
+postmortem merge orders multiple replicas' records causally by the
+clock-sync-free (epoch, step, seq) coordinates.
+"""
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.conftest import scaled_timeout
+from torchft_tpu.telemetry import postmortem
+from torchft_tpu.telemetry.blackbox import (
+    _FRAME,
+    _FRAME_MAGIC,
+    _HEADER_SIZE,
+    BlackBox,
+    read_blackbox,
+    read_native_blackbox,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestBlackBoxRing:
+    def test_round_trip_and_order(self, tmp_path):
+        path = str(tmp_path / "a.bb")
+        bb = BlackBox(path)
+        bb.set_context(replica_id="rep_a", step=0, quorum_epoch=1)
+        bb.record("quorum_start", step=0)
+        bb.record("op_issue", op="allreduce", fseq=1, plane="tcp")
+        bb.set_context(step=1, quorum_epoch=2)
+        bb.record("op_complete", fseq=1, status="completed")
+        bb.close()
+
+        records, meta = read_blackbox(path)
+        assert meta["replica"] == "rep_a"
+        assert meta["torn"] == 0
+        kinds = [r["k"] for r in records]
+        assert kinds == ["ctx", "quorum_start", "op_issue", "op_complete"]
+        # seq strictly increasing; context coordinates stamped
+        assert [r["q"] for r in records] == sorted(r["q"] for r in records)
+        assert records[1]["ep"] == 1 and records[1]["st"] == 0
+        assert records[3]["ep"] == 2 and records[3]["st"] == 1
+
+    def test_wraparound_keeps_latest(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TORCHFT_BLACKBOX_SIZE", "4096")
+        path = str(tmp_path / "w.bb")
+        bb = BlackBox(path)
+        for i in range(500):  # far more than a 4 KiB ring holds
+            bb.record("tick", i=i)
+        bb.close()
+        records, meta = read_blackbox(path)
+        assert records, "wraparound must not lose everything"
+        ticks = [r["i"] for r in records if r["k"] == "tick"]
+        # the newest record always survives, and recovered ticks are a
+        # contiguous tail of the sequence (modulo the one frame torn by
+        # the wrap point, which the reader skips, never corrupts)
+        assert ticks[-1] == 499
+        assert all(b > a for a, b in zip(ticks, ticks[1:]))
+        assert len(ticks) > 10
+
+    def test_torn_tail_skipped_never_corrupt(self, tmp_path):
+        path = str(tmp_path / "t.bb")
+        bb = BlackBox(path)
+        bb.record("good", n=1)
+        bb.record("victim", n=2)
+        bb.close()
+        # flip one payload byte of the LAST frame: its CRC must fail and
+        # the record must vanish — not parse with a wrong field
+        with open(path, "r+b") as f:
+            raw = bytearray(f.read())
+        off = _HEADER_SIZE
+        frames = []
+        while off + _FRAME.size <= len(raw):
+            magic, plen, _crc = _FRAME.unpack_from(raw, off)
+            if magic != _FRAME_MAGIC:
+                break
+            frames.append((off, plen))
+            off += _FRAME.size + plen + ((-plen) % 4)
+        assert len(frames) == 2
+        last_off, last_len = frames[-1]
+        raw[last_off + _FRAME.size + 5] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(raw)
+        records, meta = read_blackbox(path)
+        assert [r["k"] for r in records] == ["good"]
+        assert meta["torn"] >= 1
+
+    def test_sigkill_durability(self, tmp_path):
+        """A writer SIGKILLed mid-stream leaves a CRC-valid box: every
+        recovered record parses, sequence numbers are sane, and at least
+        the records written before the marker survive."""
+        box_dir = str(tmp_path)
+        marker = str(tmp_path / "marker")
+        code = f"""
+import os
+os.environ["TORCHFT_BLACKBOX_DIR"] = {box_dir!r}
+from torchft_tpu.telemetry.blackbox import BLACKBOX
+BLACKBOX.set_context(replica_id="kill_me", step=0, quorum_epoch=7)
+i = 0
+while True:
+    BLACKBOX.record("spin", i=i)
+    i += 1
+    if i == 200:
+        open({marker!r}, "w").close()
+"""
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            deadline = time.monotonic() + scaled_timeout(60)
+            while not os.path.exists(marker):
+                assert proc.poll() is None, "writer died early"
+                assert time.monotonic() < deadline, "writer never reached marker"
+                time.sleep(0.01)
+            # kill mid-write: the writer is spinning on record()
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=scaled_timeout(30))
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        boxes = [
+            f for f in os.listdir(box_dir)
+            if f.endswith(".bb") and not f.endswith("_native.bb")
+        ]
+        assert len(boxes) == 1
+        records, meta = read_blackbox(os.path.join(box_dir, boxes[0]))
+        assert meta["replica"] == "kill_me"
+        spins = [r for r in records if r["k"] == "spin"]
+        assert len(spins) >= 100, "pre-marker records must survive SIGKILL"
+        # every recovered record is fully valid JSON with the stamped
+        # coordinates — a torn record may be MISSING, never corrupt
+        for r in spins:
+            assert r["ep"] == 7 and isinstance(r["i"], int)
+        assert all(
+            b["q"] > a["q"] for a, b in zip(records, records[1:])
+        )
+
+
+class TestNativeBlackBox:
+    def test_native_ring_recovers(self, tmp_path):
+        """Exercise the native plane with the box armed (fresh process —
+        the env is read once per process at first record) and parse the
+        breadcrumbs back: rpc.serve + quorum transitions, CRC-valid."""
+        box_dir = str(tmp_path)
+        code = f"""
+import os
+os.environ["TORCHFT_BLACKBOX_DIR"] = {box_dir!r}
+from datetime import timedelta
+from torchft_tpu.coordination import LighthouseServer, LighthouseClient
+lh = LighthouseServer(bind="[::]:0", min_replicas=1)
+c = LighthouseClient(lh.address(), connect_timeout=timedelta(seconds=5))
+c.heartbeat("bbtest")
+c.digest("gA", epoch=1, step=1, digest="x", wait=False)
+c.digest("gB", epoch=1, step=1, digest="y", wait=False)
+c.close()
+lh.shutdown()
+"""
+        subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            check=True,
+            timeout=scaled_timeout(120),
+            capture_output=True,
+        )
+        boxes = [
+            f for f in os.listdir(box_dir) if f.endswith("_native.bb")
+        ]
+        assert len(boxes) == 1
+        records, meta = read_native_blackbox(os.path.join(box_dir, boxes[0]))
+        assert meta["torn"] == 0
+        kinds = {r["k"] for r in records}
+        assert "rpc.serve" in kinds
+        assert "divergence" in kinds  # the mismatched digests above
+        div = [r for r in records if r["k"] == "divergence"][0]
+        assert div["ep"] == 1 and div["st"] == 1
+        # seq-ordered, wall-clock timestamps plausibly recent
+        assert all(
+            b["q"] > a["q"] for a, b in zip(records, records[1:])
+        )
+        assert abs(records[-1]["ts"] - time.time()) < 3600
+
+    def test_native_record_struct_is_64_bytes(self):
+        # the Python parser and native/blackbox.h must stay in lockstep
+        from torchft_tpu.telemetry.blackbox import _NATIVE_REC
+
+        assert _NATIVE_REC.size == 64
+        assert struct.calcsize("<IHHQQqqqqII") == 64
+
+
+class TestPostmortemMerge:
+    def _two_boxes(self, tmp_path):
+        a = BlackBox(str(tmp_path / "tft_bb_1.bb"))
+        a.set_context(replica_id="rep_a", step=0, quorum_epoch=1)
+        a.record("quorum_start", step=0)
+        a.record("op_issue", op="allreduce", plane="tcp", fseq=1)
+        a.set_context(step=1, quorum_epoch=2)
+        a.record("op_issue", op="allreduce", plane="tcp", fseq=2)
+        a.close()  # "dies" with fseq=2 in flight at epoch 2
+        b = BlackBox(str(tmp_path / "tft_bb_2.bb"))
+        b.set_context(replica_id="rep_b", step=0, quorum_epoch=1)
+        b.record("quorum_start", step=0)
+        b.set_context(step=1, quorum_epoch=2)
+        b.record("peer_death", ring_rank=0, replica="rep_a", step=1)
+        b.record("abort", step=1)
+        b.close()
+
+    def test_merge_ordering_and_victim(self, tmp_path):
+        self._two_boxes(tmp_path)
+        report = postmortem.analyze(str(tmp_path))
+        # causal order: every epoch-1 record precedes every epoch-2 one,
+        # regardless of which replica wrote it
+        eps = [
+            r["ep"] for r in report["timeline"] if r.get("ep", -1) >= 0
+        ]
+        assert eps == sorted(eps)
+        assert report["victim"] == "rep_a"
+        assert report["victim_inflight_op"]["op"] == "allreduce"
+        assert report["victim_inflight_op"]["fseq"] == 2
+        assert report["victim_epoch"] == 2
+        assert report["first_anomaly"]["k"] == "peer_death"
+        assert report["classification"] == "new-bug"
+
+    def test_injected_classification_wins(self, tmp_path):
+        self._two_boxes(tmp_path)
+        # fault-plane evidence present -> the death was scheduled
+        with open(tmp_path / "tft_fault_1.json", "w") as f:
+            f.write(json.dumps({"site": "cma.pull", "action": "kill",
+                                "pid": 1, "hit": 3}) + "\n")
+        report = postmortem.analyze(str(tmp_path))
+        assert report["classification"] == "injected"
+
+    def test_environmental_classification(self, tmp_path):
+        self._two_boxes(tmp_path)
+        report = postmortem.analyze(
+            str(tmp_path),
+            log_text="worker: malloc(): invalid size (unsorted)",
+        )
+        assert report["classification"] == "environmental"
+
+    def test_trail_records_merge_only_without_boxes(self, tmp_path):
+        # trail-only directory: trails ARE the timeline
+        with open(tmp_path / "trail0.jsonl", "w") as f:
+            f.write(json.dumps({"ts": time.time(), "event": "commit",
+                                "step": 0}) + "\n")
+            f.write('{"torn tail')  # must be skipped, not fatal
+        report = postmortem.analyze(str(tmp_path))
+        assert any(
+            r["k"] == "commit" and r["src"] == "trail"
+            for r in report["timeline"]
+        )
+        assert report["trails_mirrored_by_boxes"] is False
+        # with boxes present, trails are an exact mirror of the boxes'
+        # event records — merging both would double-count every
+        # peer_death accusation, so they are skipped
+        self._two_boxes(tmp_path)
+        report = postmortem.analyze(str(tmp_path))
+        assert report["trails_mirrored_by_boxes"] is True
+        assert not any(r["src"] == "trail" for r in report["timeline"])
+        deaths = [
+            r for r in report["timeline"] if r["k"] == "peer_death"
+        ]
+        assert len(deaths) == 1  # once, not once-per-surface
+
+    def test_recovery_emits_event(self, tmp_path):
+        from torchft_tpu import telemetry
+
+        self._two_boxes(tmp_path)
+        telemetry.EVENTS.clear()
+        postmortem.analyze(str(tmp_path))
+        recs = telemetry.EVENTS.recent(event="blackbox_recovered")
+        assert recs and recs[-1]["boxes"] == 2
+
+    def test_cli(self, tmp_path, capsys):
+        self._two_boxes(tmp_path)
+        out_json = str(tmp_path / "report.json")
+        rc = postmortem.main([str(tmp_path), "--json", out_json])
+        assert rc == 2  # new-bug classification is a loud exit
+        text = capsys.readouterr().out
+        assert "victim: rep_a" in text
+        assert "in-flight at death: allreduce" in text
+        with open(out_json) as f:
+            assert json.load(f)["victim"] == "rep_a"
+
+
+class TestEventTrailMirror:
+    def test_emit_mirrors_into_blackbox(self, tmp_path, monkeypatch):
+        from torchft_tpu import telemetry
+
+        path = str(tmp_path / "m.bb")
+        telemetry.BLACKBOX.configure(path)
+        try:
+            telemetry.emit("commit", step=42, participants=2)
+        finally:
+            telemetry.BLACKBOX.configure(None)
+        records, _meta = read_blackbox(path)
+        commits = [r for r in records if r["k"] == "commit"]
+        assert commits and commits[0]["step"] == 42
+
+    def test_flight_mirrors_into_blackbox(self, tmp_path):
+        from torchft_tpu import telemetry
+
+        path = str(tmp_path / "f.bb")
+        telemetry.BLACKBOX.configure(path)
+        try:
+            fid = telemetry.FLIGHT.record_issue(
+                "allreduce", "tcp", 128, tag=9, rank=0
+            )
+            telemetry.FLIGHT.record_complete(fid)
+        finally:
+            telemetry.BLACKBOX.configure(None)
+        records, _meta = read_blackbox(path)
+        kinds = [r["k"] for r in records]
+        assert "op_issue" in kinds and "op_complete" in kinds
+        issue = [r for r in records if r["k"] == "op_issue"][0]
+        assert issue["op"] == "allreduce" and issue["fseq"] == fid
+
+    def test_disarmed_record_is_noop(self, monkeypatch):
+        # no env, no configure: record must be silent and cheap
+        monkeypatch.delenv("TORCHFT_BLACKBOX_DIR", raising=False)
+        bb = BlackBox()
+        bb.record("anything", x=1)
+        assert bb.path is None

@@ -220,7 +220,7 @@ def test_errored_latch_blocks_speculation_and_aborts_cleanly(harness):
     h.client._quorum.return_value = quorum_result(max_rank=1)
     # group decision echoes the local vote
     h.client.should_commit.side_effect = (
-        lambda rank, step, vote, timeout=None: vote
+        lambda rank, step, vote, timeout=None, **kw: vote
     )
 
     # clean step k: speculate
@@ -280,7 +280,7 @@ def test_deathwatch_requorum_mid_speculation_vetoes_step(harness):
     h = harness(min_replica_size=1)
     m = h.manager
     h.client.should_commit.side_effect = (
-        lambda rank, step, vote, timeout=None: vote
+        lambda rank, step, vote, timeout=None, **kw: vote
     )
     ids = ["replica_a", "replica_b"]
     h.client._quorum.side_effect = [
@@ -296,7 +296,7 @@ def test_deathwatch_requorum_mid_speculation_vetoes_step(harness):
     gate = threading.Event()
     real_vote = h.client.should_commit.side_effect
 
-    def gated_vote(rank, step, vote, timeout=None):
+    def gated_vote(rank, step, vote, timeout=None, **kw):
         gate.wait(5)
         return real_vote(rank, step, vote, timeout=timeout)
 
@@ -339,7 +339,7 @@ def test_managed_optimizer_pipelined_rollback_replay(harness):
     h.client._quorum.return_value = quorum_result(max_rank=1)
     votes = {"n": 0}
 
-    def vote_fn(rank, step, vote, timeout=None):
+    def vote_fn(rank, step, vote, timeout=None, **kw):
         votes["n"] += 1
         return vote and votes["n"] != 2  # veto the 2nd vote
 
@@ -474,7 +474,7 @@ class TestTrainerParity:
             h.client._quorum.return_value = quorum_result(max_rank=1)
             votes = {"n": 0}
 
-            def vote_fn(rank, step, vote, timeout=None):
+            def vote_fn(rank, step, vote, timeout=None, **kw):
                 votes["n"] += 1
                 return vote and votes["n"] not in self.VETO_VOTES
 

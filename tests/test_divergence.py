@@ -1,0 +1,393 @@
+"""Divergence sentinel tests (ISSUE 10).
+
+Covers the three layers separately so a failure names its layer: the
+lighthouse's ``lh.digest`` cohort compare (latch, abstain, fence wait,
+scrape surfaces), the manager server's vote-barrier digest exchange
+(fence veto through ``mgr.should_commit``), and the Python Manager's
+digest production (post-reduce fold, abstain on a doomed step). The
+end-to-end corrupt-then-latch proof lives in the faultmatrix
+(``corrupt_divergence`` scenario).
+"""
+
+import json
+import threading
+import urllib.request
+from datetime import timedelta
+from unittest.mock import MagicMock, patch
+
+import numpy as np
+import pytest
+
+from torchft_tpu.collectives import CollectivesDummy
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+)
+from torchft_tpu.manager import MANAGER_ADDR_KEY, REPLICA_ID_KEY, Manager
+from torchft_tpu.store import StoreClient, StoreServer
+
+
+def _get_json(addr: str, path: str):
+    if "://" not in addr:
+        addr = "http://" + addr
+    with urllib.request.urlopen(f"{addr}{path}", timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+class TestLighthouseDigestCompare:
+    def test_match_then_mismatch_latches(self):
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1)
+        try:
+            c = LighthouseClient(
+                lh.address(), connect_timeout=timedelta(seconds=5)
+            )
+            r = c.digest("gA", epoch=1, step=5, digest="aaaa")
+            assert r["match"] is True and r["divergence"] is False
+            r = c.digest("gB", epoch=1, step=5, digest="aaaa")
+            assert r["match"] is True and r["divergence"] is False
+            # same epoch, NEXT step, one perturbed digest -> latch
+            c.digest("gA", epoch=1, step=6, digest="cccc")
+            r = c.digest("gB", epoch=1, step=6, digest="dddd")
+            assert r["match"] is False and r["divergence"] is True
+            # the latch is global and sticky: a later clean round still
+            # reports the fleet-level divergence flag
+            r = c.digest("gA", epoch=1, step=7, digest="e")
+            assert r["match"] is True and r["divergence"] is True
+            c.close()
+        finally:
+            lh.shutdown()
+
+    def test_abstain_never_enters_comparison(self):
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1)
+        try:
+            c = LighthouseClient(
+                lh.address(), connect_timeout=timedelta(seconds=5)
+            )
+            # one group aborts its step (abstain marker), one commits:
+            # no divergence — only committing states must agree
+            c.digest("gA", epoch=2, step=1, digest="-")
+            r = c.digest("gB", epoch=2, step=1, digest="real")
+            assert r["match"] is True and r["divergence"] is False
+            assert r["reports"] == 2  # the abstain still completed the round
+            c.close()
+        finally:
+            lh.shutdown()
+
+    def test_fence_wait_blocks_until_cohort(self):
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1)
+        try:
+            out = {}
+
+            def report(name, digest):
+                c = LighthouseClient(
+                    lh.address(), connect_timeout=timedelta(seconds=5)
+                )
+                out[name] = c.digest(
+                    name, epoch=3, step=1, digest=digest,
+                    wait=True, cohort=2, timeout=timedelta(seconds=20),
+                )
+                c.close()
+
+            t = threading.Thread(target=report, args=("gA", "x"))
+            t.start()
+            import time
+
+            time.sleep(0.2)
+            assert "gA" not in out, "fence wait returned before the cohort"
+            report("gB", "y")
+            t.join(timeout=20)
+            assert out["gA"]["match"] is False
+            assert out["gB"]["match"] is False
+        finally:
+            lh.shutdown()
+
+    def test_scrape_surfaces(self):
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1)
+        try:
+            addr = lh.address()
+            c = LighthouseClient(addr, connect_timeout=timedelta(seconds=5))
+            c.digest("gA", epoch=4, step=1, digest="p")
+            c.digest("gB", epoch=4, step=1, digest="q")
+            c.close()
+            status = _get_json(addr, "/status.json")
+            assert status["divergence_detected"] is True
+            assert status["divergence_total"] == 1
+            cluster = _get_json(addr, "/cluster.json")
+            assert cluster["divergence_detected"] is True
+            assert cluster["divergence_total"] == 1
+            with urllib.request.urlopen(f"{addr}/metrics", timeout=5) as r:
+                text = r.read().decode()
+            assert "torchft_divergence_total 1" in text
+            assert "torchft_divergence_detected 1" in text
+        finally:
+            lh.shutdown()
+
+    def test_missing_fields_rejected(self):
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1)
+        try:
+            c = LighthouseClient(
+                lh.address(), connect_timeout=timedelta(seconds=5)
+            )
+            with pytest.raises(RuntimeError):
+                c.digest("", epoch=0, step=0, digest="x")
+            c.close()
+        finally:
+            lh.shutdown()
+
+
+class TestManagerSrvFence:
+    def _setup(self):
+        lh = LighthouseServer(
+            bind="[::]:0", min_replicas=1, join_timeout_ms=100
+        )
+        mgr = ManagerServer(
+            replica_id="rep_0", lighthouse_addr=lh.address(),
+            hostname="localhost", bind="[::]:0", store_addr="s",
+            world_size=1,
+        )
+        client = ManagerClient(
+            mgr.address(), connect_timeout=timedelta(seconds=10)
+        )
+        # form the quorum once so the fence's cohort (= quorum size, 1)
+        # is defined
+        client._quorum(
+            rank=0, step=0, checkpoint_metadata="m",
+            shrink_only=False, timeout=timedelta(seconds=10),
+        )
+        return lh, mgr, client
+
+    def test_clean_digest_commits(self):
+        lh, mgr, client = self._setup()
+        try:
+            decision = client.should_commit(
+                0, 0, True, timeout=timedelta(seconds=10),
+                digest="d0", epoch=1, fence=True,
+            )
+            assert decision is True
+            assert client.last_divergence is False
+        finally:
+            client.close()
+            mgr.shutdown()
+            lh.shutdown()
+
+    def test_fence_vetoes_on_mismatch(self):
+        lh, mgr, client = self._setup()
+        try:
+            # a conflicting report lands in the same (epoch, step) round
+            # before the vote (the "other group" in miniature)
+            lhc = LighthouseClient(
+                lh.address(), connect_timeout=timedelta(seconds=5)
+            )
+            lhc.digest("rep_other", epoch=1, step=1, digest="other")
+            lhc.close()
+            decision = client.should_commit(
+                0, 1, True, timeout=timedelta(seconds=10),
+                digest="mine", epoch=1, fence=True,
+            )
+            # every rank voted True, but the lighthouse compare
+            # disagreed: the fence turns the commit into an abort and
+            # the reply carries the divergence flag
+            assert decision is False
+            assert client.last_divergence is True
+        finally:
+            client.close()
+            mgr.shutdown()
+            lh.shutdown()
+
+    def test_sentinel_without_fence_reports_but_commits(self):
+        lh, mgr, client = self._setup()
+        try:
+            lhc = LighthouseClient(
+                lh.address(), connect_timeout=timedelta(seconds=5)
+            )
+            lhc.digest("rep_other", epoch=1, step=2, digest="other")
+            lhc.close()
+            decision = client.should_commit(
+                0, 2, True, timeout=timedelta(seconds=10),
+                digest="mine", epoch=1, fence=False,
+            )
+            assert decision is True  # detection-only mode never vetoes
+            assert client.last_divergence is True
+        finally:
+            client.close()
+            mgr.shutdown()
+            lh.shutdown()
+
+
+class TestManagerSentinel:
+    """Python Manager side: digest production + abstain, with a mocked
+    coordination client (the real RPC surface is covered above)."""
+
+    def _manager(self, store_server, monkeypatch, fence=False):
+        monkeypatch.setenv("TORCHFT_DIVERGENCE_SENTINEL", "1")
+        if fence:
+            monkeypatch.setenv("TORCHFT_DIVERGENCE_FENCE", "1")
+        store = StoreClient(store_server.address())
+        store.set(MANAGER_ADDR_KEY, "dummy")
+        store.set(REPLICA_ID_KEY, "dummy_id")
+        patcher = patch(
+            "torchft_tpu.manager.ManagerClient", autospec=True
+        )
+        patcher.start()
+        transport = MagicMock()
+        transport.metadata.return_value = "meta"
+        manager = Manager(
+            collectives=CollectivesDummy(rank=0, world_size=1),
+            load_state_dict=lambda s: None,
+            state_dict=lambda: {"w": 1},
+            min_replica_size=2,
+            rank=1,
+            world_size=2,
+            store_addr=store_server.address(),
+            checkpoint_transport=transport,
+            timeout=timedelta(seconds=10),
+        )
+        return manager, patcher
+
+    @staticmethod
+    def _quorum_result():
+        from torchft_tpu.coordination import QuorumResult
+
+        q = QuorumResult()
+        q.quorum_id = 9
+        q.replica_rank = 1
+        q.replica_world_size = 2
+        q.max_rank = 1
+        q.max_world_size = 2
+        q.max_step = 0
+        q.store_address = "store/prefix"
+        return q
+
+    def test_digest_flows_into_vote(self, monkeypatch):
+        store_server = StoreServer()
+        manager, patcher = self._manager(store_server, monkeypatch)
+        try:
+            manager._client._quorum.return_value = self._quorum_result()
+            manager._client.should_commit.return_value = True
+            manager.start_quorum()
+            t = np.array([2.0, 4.0], dtype=np.float32)
+            manager.allreduce(t).wait()
+            assert manager.should_commit()
+            kwargs = manager._client.should_commit.call_args.kwargs
+            digest = kwargs["digest"]
+            assert isinstance(digest, str) and digest != "-"
+            assert kwargs["epoch"] == 9
+            assert kwargs["fence"] is False
+            # deterministic: the same reduced bytes fold to the same
+            # digest (this equality IS the cross-group invariant)
+            from torchft_tpu.checkpointing import delta
+
+            expected = delta.tree_digest(
+                [delta.tree_digest(delta.leaf_digests([t]))]
+            )
+            assert digest == expected
+        finally:
+            manager.shutdown(wait=False)
+            patcher.stop()
+            store_server.shutdown()
+
+    def test_doomed_step_abstains(self, monkeypatch):
+        store_server = StoreServer()
+        manager, patcher = self._manager(store_server, monkeypatch)
+        try:
+            manager._client._quorum.return_value = self._quorum_result()
+            manager._client.should_commit.return_value = False
+            manager.start_quorum()
+            t = np.array([1.0], dtype=np.float32)
+            manager.allreduce(t).wait()
+            manager.report_error(RuntimeError("boom"))
+            assert manager.should_commit() is False
+            kwargs = manager._client.should_commit.call_args.kwargs
+            assert kwargs["digest"] == "-"
+        finally:
+            manager.shutdown(wait=False)
+            patcher.stop()
+            store_server.shutdown()
+
+    def test_fence_implies_sentinel_and_flag(self, monkeypatch):
+        store_server = StoreServer()
+        manager, patcher = self._manager(
+            store_server, monkeypatch, fence=True
+        )
+        try:
+            assert manager._divergence_sentinel is True
+            manager._client._quorum.return_value = self._quorum_result()
+            manager._client.should_commit.return_value = True
+            manager.start_quorum()
+            t = np.array([1.0], dtype=np.float32)
+            manager.allreduce(t).wait()
+            assert manager.should_commit()
+            assert (
+                manager._client.should_commit.call_args.kwargs["fence"]
+                is True
+            )
+        finally:
+            manager.shutdown(wait=False)
+            patcher.stop()
+            store_server.shutdown()
+
+    def test_sentinel_off_sends_no_digest(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_DIVERGENCE_SENTINEL", raising=False)
+        monkeypatch.delenv("TORCHFT_DIVERGENCE_FENCE", raising=False)
+        store_server = StoreServer()
+        store = StoreClient(store_server.address())
+        store.set(MANAGER_ADDR_KEY, "dummy")
+        store.set(REPLICA_ID_KEY, "dummy_id")
+        patcher = patch(
+            "torchft_tpu.manager.ManagerClient", autospec=True
+        )
+        patcher.start()
+        transport = MagicMock()
+        transport.metadata.return_value = "meta"
+        manager = Manager(
+            collectives=CollectivesDummy(rank=0, world_size=1),
+            load_state_dict=lambda s: None,
+            state_dict=lambda: {"w": 1},
+            min_replica_size=2,
+            rank=1,
+            world_size=2,
+            store_addr=store_server.address(),
+            checkpoint_transport=transport,
+            timeout=timedelta(seconds=10),
+        )
+        try:
+            manager._client._quorum.return_value = self._quorum_result()
+            manager._client.should_commit.return_value = True
+            manager.start_quorum()
+            assert manager.should_commit()
+            assert (
+                manager._client.should_commit.call_args.kwargs["digest"]
+                is None
+            )
+        finally:
+            manager.shutdown(wait=False)
+            patcher.stop()
+            store_server.shutdown()
+
+    def test_divergence_reply_emits_once(self, monkeypatch):
+        from torchft_tpu import telemetry
+
+        store_server = StoreServer()
+        manager, patcher = self._manager(store_server, monkeypatch)
+        try:
+            manager._client._quorum.return_value = self._quorum_result()
+            manager._client.should_commit.return_value = True
+            manager._client.last_divergence = True
+            telemetry.EVENTS.clear()
+            before = telemetry.DIVERGENCE_TOTAL.value
+            manager.start_quorum()
+            np_t = np.array([1.0], dtype=np.float32)
+            manager.allreduce(np_t).wait()
+            manager.should_commit()
+            manager.start_quorum()
+            manager.allreduce(np_t).wait()
+            manager.should_commit()
+            events = telemetry.EVENTS.recent(event="divergence_detected")
+            assert len(events) == 1  # latched once, not per step
+            assert telemetry.DIVERGENCE_TOTAL.value == before + 1
+        finally:
+            manager.shutdown(wait=False)
+            patcher.stop()
+            store_server.shutdown()

@@ -399,7 +399,21 @@ class TestCheckpointTracePropagation:
                     timeout=timedelta(seconds=5),
                 )
             np.testing.assert_array_equal(got["w"], state["w"])
-            serves = telemetry.TRACER.recent("checkpoint_serve")
+            # the serve span is recorded on the HTTP server thread,
+            # which finishes AFTER the client's recv returns — poll
+            # briefly, and filter to THIS heal's trace so a straggler
+            # serve span from a previous in-process test can't be
+            # mistaken for ours
+            deadline = time.time() + 5
+            serves = []
+            while not serves and time.time() < deadline:
+                serves = [
+                    s
+                    for s in telemetry.TRACER.recent("checkpoint_serve")
+                    if s["trace_id"] == "healer:3:9"
+                ]
+                if not serves:
+                    time.sleep(0.01)
             assert serves, "serving side recorded no span"
             serve = serves[-1]
             assert serve["parent_id"] == parent.span_id

@@ -43,7 +43,10 @@ _TIMEOUT_CODES = (CANCELLED, DEADLINE_EXCEEDED)
 # the bf16 wire, so a mismatch forces a rebuild instead of proceeding.
 # v3: tft_lathist_snapshot/tft_lathist_reset (native latency histograms).
 # v4: tft_blob_* (striped checkpoint blob plane, native/blob.cc).
-_ABI_VERSION = 4
+# v5: divergence sentinel (mgr.should_commit digest fields + lh.digest
+#     RPC) and crash-durable native blackbox breadcrumbs (blackbox.h) —
+#     an old build would silently drop digests, so mismatch = rebuild.
+_ABI_VERSION = 5
 
 
 def _build(force: bool = False) -> None:

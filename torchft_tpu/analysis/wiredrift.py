@@ -38,8 +38,9 @@ Checks (rule ids):
     no-ops in deploy configs.
 
 ``obs-env-drift``
-    Same contract for the step-anatomy/SLO/straggler knob families
-    (``TORCHFT_SLO_*`` / ``TORCHFT_STRAGGLER_*``) against the knob
+    Same contract for the step-anatomy/SLO/straggler/forensics/
+    divergence knob families (``TORCHFT_SLO_*`` / ``TORCHFT_STRAGGLER_*``
+    / ``TORCHFT_BLACKBOX_*`` / ``TORCHFT_DIVERGENCE_*``) against the knob
     registry in ``docs/observability.md``.
 
 ``heal-env-drift``
@@ -277,15 +278,18 @@ def check_wire_env(
     return finds
 
 
-_OBS_RE = re.compile(r"TORCHFT_(?:SLO|STRAGGLER)_[A-Z0-9_]+")
+_OBS_RE = re.compile(
+    r"TORCHFT_(?:SLO|STRAGGLER|BLACKBOX|DIVERGENCE)_[A-Z0-9_]+"
+)
 
 
 def check_obs_env(
     py_texts: Dict[str, str], obs_doc_text: str
 ) -> List[Finding]:
-    """The TORCHFT_SLO_* / TORCHFT_STRAGGLER_* knob families vs the
-    docs/observability.md knob registry, both directions (the
-    wire-env-drift contract for the step-anatomy plane)."""
+    """The TORCHFT_SLO_* / TORCHFT_STRAGGLER_* / TORCHFT_BLACKBOX_* /
+    TORCHFT_DIVERGENCE_* knob families vs the docs/observability.md
+    knob registry, both directions (the wire-env-drift contract for the
+    step-anatomy, forensics and divergence planes)."""
     py: Set[str] = set()
     for text in py_texts.values():
         py.update(_OBS_RE.findall(text))

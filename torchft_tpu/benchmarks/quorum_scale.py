@@ -1,0 +1,188 @@
+"""Quorum fan-out latency vs group count — the HA open item's measurement.
+
+The ROADMAP's HA control-plane item names the single lighthouse as an
+O(N) fan-in bottleneck and asks for "a bench row for quorum p50/p99 vs
+group count" before any hierarchical-quorum work can claim a win. PR 8
+landed the measurement substrate (the native ``quorum.fanout`` latency
+histogram — one observation per ManagerSrv ``lh.quorum`` long-poll round
+trip); this module drives it at scale: **N simulated manager clients
+against ONE lighthouse** for N in ``--groups`` (default ``8,32,64``),
+each doing ``--rounds`` full quorum rounds, then snapshots the in-process
+lathist and reports per-N ``quorum.fanout`` p50/p99.
+
+"Simulated" means real protocol, minimal weight: every group is a real
+in-process ``ManagerServer`` (world_size=1 — heartbeat loop, lh.quorum
+long-poll, the exact fan-in the lighthouse pays) plus one thread driving
+``mgr.quorum`` through a real ``ManagerClient``. Everything shares this
+process, so ``_native.lathist_snapshot`` sees every fan-out observation
+and the numbers are pure control-plane cost (no training, no data
+plane).
+
+Caveat recorded in the row: all N servers time-share this host's cores,
+so large N on a small box measures scheduling pressure as well as
+protocol cost — the cross-N *shape* (does p99 grow superlinearly?) is
+the signal, the absolute values are box-bound like every other row.
+
+Run: ``python -m torchft_tpu.benchmarks.quorum_scale`` (CPU platform;
+prints one JSON line: ``{"quorum_scale": {...}}``).
+"""
+
+import argparse
+import json
+import threading
+import time
+from datetime import timedelta
+from typing import Dict, List
+
+
+def _quorum_round(client, rank: int, step: int, timeout_s: float) -> None:
+    client._quorum(
+        rank=rank,
+        step=step,
+        checkpoint_metadata="",
+        shrink_only=False,
+        timeout=timedelta(seconds=timeout_s),
+    )
+
+
+def measure_groups(n: int, rounds: int, timeout_s: float) -> Dict:
+    """One lighthouse, ``n`` manager servers + clients, ``rounds`` full
+    quorum rounds; returns the ``quorum.fanout`` digest for exactly this
+    configuration (the histogram is reset on entry)."""
+    from torchft_tpu import _native
+    from torchft_tpu.coordination import (
+        LighthouseServer,
+        ManagerClient,
+        ManagerServer,
+    )
+    from torchft_tpu.telemetry.anatomy import lathist_quantile
+
+    _native.lathist_reset()
+    lighthouse = LighthouseServer(
+        bind="[::]:0",
+        min_replicas=n,
+        # long join window: N servers booting on a small box must not
+        # split the first quorum round
+        join_timeout_ms=60000,
+    )
+    managers: List[ManagerServer] = []
+    clients: List[ManagerClient] = []
+    errors: List[str] = []
+    t_setup = time.perf_counter()
+    try:
+        for i in range(n):
+            managers.append(
+                ManagerServer(
+                    replica_id=f"qs_{i}",
+                    lighthouse_addr=lighthouse.address(),
+                    hostname="localhost",
+                    bind="[::]:0",
+                    store_addr="unused:0",
+                    world_size=1,
+                    # modest heartbeat so N groups don't saturate the
+                    # box with heartbeat traffic between rounds
+                    heartbeat_interval=timedelta(milliseconds=500),
+                    connect_timeout=timedelta(seconds=timeout_s),
+                )
+            )
+        clients = [
+            ManagerClient(
+                m.address(), connect_timeout=timedelta(seconds=timeout_s)
+            )
+            for m in managers
+        ]
+        setup_s = time.perf_counter() - t_setup
+
+        t0 = time.perf_counter()
+        for rnd in range(rounds):
+            threads = []
+            for i, c in enumerate(clients):
+                th = threading.Thread(
+                    target=lambda c=c, i=i: (
+                        errors.append(f"g{i}: fail")
+                        if _try(_quorum_round, c, 0, rnd, timeout_s)
+                        else None
+                    ),
+                    name=f"qs_client_{i}",
+                )
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+        wall_s = time.perf_counter() - t0
+
+        snap = _native.lathist_snapshot().get("quorum.fanout", {})
+        count = int(snap.get("count", 0))
+        out = {
+            "groups": n,
+            "rounds": rounds,
+            "fanout_count": count,
+            "fanout_p50_s": round(lathist_quantile(snap, 0.5), 6)
+            if count
+            else None,
+            "fanout_p99_s": round(lathist_quantile(snap, 0.99), 6)
+            if count
+            else None,
+            "setup_s": round(setup_s, 3),
+            "wall_s": round(wall_s, 3),
+            "errors": len(errors),
+        }
+        if count < n * rounds:
+            out["note"] = (
+                f"only {count}/{n * rounds} fan-outs recorded "
+                "(client errors or joins folded into one round)"
+            )
+        return out
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for m in managers:
+            try:
+                m.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        lighthouse.shutdown()
+
+
+def _try(fn, *args) -> bool:
+    """Returns True on FAILURE (reads nicer at the call site above)."""
+    try:
+        fn(*args)
+        return False
+    except Exception:  # noqa: BLE001 — counted, not raised
+        return True
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", default="8,32,64",
+                    help="comma-separated group counts")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    args = ap.parse_args()
+
+    rows: Dict[str, Dict] = {}
+    for n in [int(x) for x in args.groups.split(",") if x]:
+        try:
+            rows[f"groups_{n}"] = measure_groups(
+                n, args.rounds, args.timeout
+            )
+        except Exception as e:  # noqa: BLE001 — partial results still land
+            rows[f"groups_{n}"] = {"error": str(e)}
+    print(json.dumps({
+        "quorum_scale": {
+            "_gate_presence": True,
+            **rows,
+            "note": "quorum.fanout p50/p99 per group count (N in-process "
+            "manager servers against one lighthouse, native lathist "
+            "substrate from PR 8); shape-over-N is the signal, absolutes "
+            "are box-bound",
+        }
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -362,6 +362,22 @@ def _flat_view(arr: np.ndarray) -> np.ndarray:
     return v
 
 
+def _corrupt_buffers(result: Any, frac: float) -> None:
+    """``corrupt(frac)`` injection semantics at ``collective.complete``:
+    silently perturb the leading ``frac`` of the first finished buffer's
+    elements on THIS replica only (+1.0 — finite, so nothing downstream
+    errors; the corruption is only observable as cross-group digest /
+    checksum divergence, which is exactly the hole the commit-time
+    divergence sentinel exists to close)."""
+    arrays = result if isinstance(result, (list, tuple)) else [result]
+    for arr in arrays:
+        if isinstance(arr, np.ndarray) and arr.size:
+            n = max(1, int(arr.size * frac))
+            flat = arr.reshape(-1)
+            flat[:n] += flat.dtype.type(1)
+            return
+
+
 class _Peer:
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
@@ -877,10 +893,25 @@ class CollectivesTcp(Collectives):
                     # completion-side injection site: a delay here holds
                     # the op thread (stalling the ring like a wedged
                     # peer); an error fails the finished op before its
-                    # future resolves
-                    fault_point(
-                        "collective.complete", match=op, rank=self._rank
+                    # future resolves; `corrupt` silently perturbs the
+                    # finished buffers on THIS replica only — the
+                    # divergence-sentinel adversary (no error surfaces,
+                    # so without the commit-time digest compare the
+                    # corrupt averages would commit)
+                    inj = fault_point(
+                        "collective.complete", match=op, rank=self._rank,
+                        wire=True,
                     )
+                    if inj is not None:
+                        if inj.action == "corrupt":
+                            _corrupt_buffers(result, inj.frac)
+                        elif inj.action in ("drop", "torn"):
+                            # no wire semantics for these here: degrade
+                            # to error so a schedule can never silently
+                            # no-op (delay/kill were already applied
+                            # inline by fault_point — re-raising them
+                            # would turn a stall into a failed op)
+                            raise inj.make_exception()
                 out.set_result(result)
             except BaseException as e:  # noqa: BLE001 — propagate via future
                 out.set_exception(e)

@@ -344,10 +344,28 @@ class CollectivesDevice(Collectives):
         if run_op is not None:
             self._compute(run_op)
         out = future_timeout(fut, self._timeout)
-        out.then(
-            lambda f: telemetry.FLIGHT.record_complete(fid, error=f.exception())
-        )
-        return Work(out)
+
+        def complete(f: Future) -> Any:
+            telemetry.FLIGHT.record_complete(fid, error=f.exception())
+            value = f.value()  # re-raises the op's failure, if any
+            # completion-side injection (parity with the host plane's
+            # site in CollectivesTcp._submit): `corrupt` silently
+            # perturbs THIS group's finished output — the divergence-
+            # sentinel adversary on the device plane
+            inj = fault_point(
+                "collective.complete", match=f"device.{kind}",
+                rank=self._rank, wire=True,
+            )
+            if inj is not None:
+                if inj.action == "corrupt":
+                    value = _corrupt_device_result(value, inj.frac)
+                elif inj.action in ("drop", "torn"):
+                    # no wire semantics here: degrade to error — never a
+                    # silent no-op (delay/kill already applied inline)
+                    raise inj.make_exception()
+            return value
+
+        return Work(out.then(complete))
 
     def _compute(self, op: _Op) -> None:
         try:
@@ -480,6 +498,29 @@ def _as_device(arr: Any):
     if isinstance(arr, jax.Array):
         return arr
     return jnp.asarray(arr)
+
+
+def _corrupt_device_result(value: Any, frac: float) -> Any:
+    """``corrupt(frac)`` injection semantics on the device plane: +1 on
+    the leading ``frac`` of the first output's elements, THIS group only
+    (see collectives._corrupt_buffers — same adversary, immutable-array
+    edition: the perturbed copy replaces the result)."""
+    import jax.numpy as jnp
+
+    arrays = value if isinstance(value, (list, tuple)) else [value]
+    out = list(arrays)
+    for i, arr in enumerate(out):
+        size = int(getattr(arr, "size", 0) or 0)
+        if not size:
+            continue
+        host = np.array(arr)
+        n = max(1, int(size * frac))
+        host.reshape(-1)[:n] += host.dtype.type(1)
+        out[i] = jnp.asarray(host)
+        break
+    if isinstance(value, (list, tuple)):
+        return type(value)(out)
+    return out[0]
 
 
 # ---------------------------------------------------------------------------

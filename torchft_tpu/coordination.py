@@ -173,6 +173,10 @@ class ManagerClient:
     """Client for a ManagerServer (src/lib.rs:115-238 analogue). Timeouts
     travel in-band and are enforced server-side (grpc-timeout parity)."""
 
+    # divergence flag of the most recent should_commit reply (class-level
+    # default so spec'd test doubles expose the attribute too)
+    last_divergence = False
+
     def __init__(self, addr: str, connect_timeout: timedelta) -> None:
         self._client = _native.NativeClient(addr, _ms(connect_timeout))
 
@@ -246,7 +250,19 @@ class ManagerClient:
         step: int,
         should_commit: bool,
         timeout: timedelta,
+        digest: Optional[str] = None,
+        epoch: int = -1,
+        fence: bool = False,
     ) -> bool:
+        """``digest`` piggybacks the divergence sentinel's post-reduce
+        state digest on this existing vote RPC (zero extra round trips);
+        the manager server folds the group's rank digests and reports
+        them to the lighthouse's (epoch, step) cohort compare. With
+        ``fence`` the lighthouse arbitrates BEFORE the decision
+        publishes — a digest mismatch vetoes the commit. The reply's
+        divergence flag is latched on :attr:`last_divergence` (the
+        Manager reads it after the call; a tuple return would break the
+        bool contract every existing caller relies on)."""
         from torchft_tpu import telemetry
         from torchft_tpu.faultinject.core import fault_point
 
@@ -255,19 +271,23 @@ class ManagerClient:
         fault_point(
             "commit.vote", match="rpc", rank=rank, step=step,
         )
+        req: Dict[str, Any] = {
+            "rank": rank,
+            "step": step,
+            "should_commit": should_commit,
+            "trace": telemetry.TRACER.inject(),
+        }
+        if digest is not None:
+            req["digest"] = digest
+            req["epoch"] = epoch
+            req["fence"] = fence
         with telemetry.TRACER.span(
             "should_commit_rpc", rank=rank, step=step, vote=should_commit
         ):
             resp = self._client.call(
-                "mgr.should_commit",
-                {
-                    "rank": rank,
-                    "step": step,
-                    "should_commit": should_commit,
-                    "trace": telemetry.TRACER.inject(),
-                },
-                _ms(timeout),
+                "mgr.should_commit", req, _ms(timeout)
             )
+        self.last_divergence = bool(resp.get("divergence", False))
         return resp["should_commit"]
 
     def kill(self, msg: str = "", timeout: timedelta = timedelta(seconds=10)) -> None:
@@ -329,6 +349,33 @@ class LighthouseClient:
             "lh.evict", {"reporter": reporter, "victim": victim}, _ms(timeout)
         )
         return bool(resp.get("evicted", False))
+
+    def digest(
+        self,
+        replica_id: str,
+        epoch: int,
+        step: int,
+        digest: str,
+        wait: bool = False,
+        cohort: int = 0,
+        timeout: timedelta = timedelta(seconds=10),
+    ) -> Dict[str, Any]:
+        """Report one replica's commit-time state digest to the
+        lighthouse's (epoch, step) cohort compare (the divergence
+        sentinel's RPC — normally the manager server does this from the
+        vote barrier). ``wait`` long-polls until the full cohort
+        reported (``cohort`` overrides the quorum size for tooling);
+        returns ``{"match", "divergence", "reports"}``."""
+        req: Dict[str, Any] = {
+            "replica_id": replica_id,
+            "epoch": epoch,
+            "step": step,
+            "digest": digest,
+            "wait": wait,
+        }
+        if cohort:
+            req["cohort"] = cohort
+        return self._client.call("lh.digest", req, _ms(timeout))
 
     def close(self) -> None:
         self._client.close()

@@ -29,12 +29,15 @@ site                      where it fires
 ========================  ====================================================
 
 Actions: ``delay(ms)``, ``drop``, ``error(exc)``, ``torn(frac)`` (partial
-write / torn read — the mid-op-peer-death emulation), ``kill(sig)``.
-``delay``/``error``/``kill`` are applied inline by :func:`fault_point`;
-``drop``/``torn`` are returned to wire-capable call sites (those passing
-``wire=True``) which implement the transport-specific semantics — at a
-non-wire site they degrade to ``error`` so a schedule can never silently
-no-op.
+write / torn read — the mid-op-peer-death emulation), ``kill(sig)``, and
+``corrupt(frac)`` (silent single-replica output perturbation of ``frac``
+of a finished op's buffer — the divergence-sentinel adversary: no error
+is raised, the corrupt averages would commit unless the commit-time
+digest compare catches them). ``delay``/``error``/``kill`` are applied
+inline by :func:`fault_point`; ``drop``/``torn``/``corrupt`` are
+returned to wire-capable call sites (those passing ``wire=True``) which
+implement the transport-specific semantics — at a non-wire site they
+degrade to ``error`` so a schedule can never silently no-op.
 
 Schedules are JSON (inline or ``@/path/to/file``) via
 ``TORCHFT_FAULT_SCHEDULE`` or :func:`configure`::
@@ -130,7 +133,7 @@ NATIVE_SITES = (
     "rpc.send",
 )
 
-ACTIONS = ("delay", "drop", "error", "torn", "kill")
+ACTIONS = ("delay", "drop", "error", "torn", "kill", "corrupt")
 
 # Environmental-corruption catalog (ROADMAP open item, PR 2 post-mortem):
 # on this box a worker can die of heap corruption (glibc aborts), its
@@ -453,7 +456,7 @@ def fault_point(site: str, match: str = "", wire: bool = False,
         return inj  # non-fatal signals (incl. sig=0 probes) return
     if inj.action == "error" or not wire:
         raise inj.make_exception()
-    return inj  # drop / torn: the wire layer implements the semantics
+    return inj  # drop / torn / corrupt: the call site implements them
 
 
 def read_evidence(evidence_dir: Optional[str] = None) -> List[Dict[str, Any]]:

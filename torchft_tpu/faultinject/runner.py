@@ -154,6 +154,45 @@ SCENARIOS: List[Scenario] = [
         "checksum-divergence hypothesis); partial bytes must never "
         "average into a committed step",
         victim_env={"TORCHFT_FI_CMA_TORN": "3:0.5"},
+        # the divergence sentinel rides along: abstain semantics must
+        # hold through torn-op aborts (no false latch), and the quick/
+        # sanitizer matrix then drives the new lh.digest native path
+        # under ASan/TSan (ISSUE 10 acceptance)
+        common_env={"TORCHFT_DIVERGENCE_SENTINEL": "1"},
+    ),
+    Scenario(
+        name="postmortem_kill_allreduce",
+        description="victim SIGKILLed mid-allreduce (the CMA kill site); "
+        "the postmortem tool — from the crash-durable black boxes ALONE — "
+        "must name the victim replica, its last in-flight op (allreduce) "
+        "and the quorum epoch, with checksums bit-identical after heal "
+        "(custom runner: run_postmortem_scenario)",
+        victim_env={"TORCHFT_FI_CMA_KILL": "3"},
+        expect_victim_death=True,
+    ),
+    Scenario(
+        name="corrupt_divergence",
+        description="corrupt(frac) perturbs one replica's finished "
+        "allreduce output (collective.complete) — silent, finite, no "
+        "error raised: the PR 2 corrupt-commit hole. Three legs (custom "
+        "runner run_divergence_scenario): sentinel-only must latch "
+        "divergence within one commit of the injection; under "
+        "TORCHFT_DIVERGENCE_FENCE=1 the commit must ABORT instead "
+        "(checksums stay bit-identical); an equal-length control soak "
+        "must latch nothing (digests are bit-identical by construction)",
+        common_env={"TORCHFT_DIVERGENCE_SENTINEL": "1"},
+        victim_schedule={
+            "seed": 6,
+            "rules": [
+                {
+                    "site": "collective.complete",
+                    "match": "allreduce",
+                    "nth": 5,
+                    "action": "corrupt",
+                    "frac": 0.05,
+                }
+            ],
+        },
     ),
     Scenario(
         name="commit_vote_delay_pipeline",
@@ -293,6 +332,10 @@ def _spawn(gid: int, lighthouse_addr: str, workdir: str, steps: int,
         JAX_PLATFORMS="cpu",
         TORCHFT_FAULT_EVIDENCE_DIR=os.path.join(workdir, "evidence"),
         TORCHFT_EVENT_TRAIL=os.path.join(workdir, f"trail{gid}.jsonl"),
+        # every worker keeps a crash-durable black box: scenario failures
+        # auto-collect them into a postmortem report (ISSUE 10), and the
+        # postmortem_kill_allreduce scenario asserts on them directly
+        TORCHFT_BLACKBOX_DIR=os.path.join(workdir, "blackbox"),
     )
     env.update(env_extra)
     log = open(
@@ -794,6 +837,285 @@ def run_straggler_scenario(
     )
 
 
+def run_postmortem_scenario(
+    scn: Scenario, workdir: str, steps: int = 16, timeout_s: float = 600.0,
+    extra_env: Optional[Dict[str, str]] = None,
+    worker_argv: Optional[List[str]] = None,
+) -> Result:
+    """The ``postmortem_kill_allreduce`` scenario (ISSUE 10): the
+    standard mid-allreduce SIGKILL run, then the forensic assertion —
+    ``telemetry.postmortem`` pointed at the crash-durable black boxes
+    ALONE (not the logs, not the evidence files) must name the victim
+    replica, its last in-flight op, and the quorum epoch it died in."""
+    res = run_scenario(scn, workdir, steps=steps, timeout_s=timeout_s,
+                       extra_env=extra_env, worker_argv=worker_argv)
+    if res.status != "passed":
+        return res
+    from torchft_tpu.telemetry import postmortem
+
+    bb_dir = os.path.join(workdir, "blackbox")
+    report = postmortem.analyze(bb_dir)
+    victim = report.get("victim") or ""
+    # the killed group is gid 1; its replica_id is the example-chosen
+    # prefix + a uuid4 suffix — a bare "pid:N" means the boxes never
+    # carried replica attribution, which is itself a failure
+    if not victim.startswith(("train_bytes_1", "san_worker_1")):
+        return Result(
+            scn.name, "failed",
+            f"postmortem (black boxes alone) named victim {victim!r}, "
+            f"expected the killed group 1 replica; report: "
+            f"{postmortem.render_text(report)}",
+            fired=res.fired, respawns=res.respawns, checksums=res.checksums,
+        )
+    op = report.get("victim_inflight_op") or {}
+    if op.get("op") != "allreduce":
+        return Result(
+            scn.name, "failed",
+            f"postmortem named in-flight op {op!r}, expected an "
+            "allreduce (the victim died mid-ring)",
+            fired=res.fired, respawns=res.respawns, checksums=res.checksums,
+        )
+    if not isinstance(report.get("victim_epoch"), int) \
+            or report["victim_epoch"] < 0:
+        return Result(
+            scn.name, "failed",
+            f"postmortem recovered no quorum epoch for the victim "
+            f"({report.get('victim_epoch')!r})",
+            fired=res.fired, respawns=res.respawns, checksums=res.checksums,
+        )
+    with open(os.path.join(workdir, "evidence", "postmortem.json"),
+              "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, default=str)
+    return Result(
+        scn.name, "passed",
+        f"black boxes alone named victim={victim} inflight="
+        f"{op.get('op')} epoch={report['victim_epoch']}; "
+        f"checksums {res.checksums[0]} == {res.checksums[1]}",
+        fired=res.fired, respawns=res.respawns, checksums=res.checksums,
+    )
+
+
+def run_divergence_scenario(
+    scn: Scenario, workdir: str, steps: int = 16, timeout_s: float = 600.0,
+    extra_env: Optional[Dict[str, str]] = None,
+    worker_argv: Optional[List[str]] = None,
+) -> Result:
+    """The ``corrupt_divergence`` scenario (ISSUE 10): three legs.
+
+    **sentinel leg** — ``corrupt(frac)`` silently perturbs group 1's
+    finished allreduce output once. Nothing errors, the corrupt average
+    COMMITS (this is the PR 2 hole) — so final checksums legitimately
+    diverge; the assertion is that the lighthouse's commit-time digest
+    compare latched (`divergence_total >= 1`) and that a worker trail
+    records ``divergence_detected`` within one commit of the
+    ``fault_injected`` record.
+
+    **fence leg** — same injection under ``TORCHFT_DIVERGENCE_FENCE=1``:
+    the lighthouse arbitrates BEFORE the decision publishes, the corrupt
+    commit is vetoed on every group, and final checksums must be finite
+    and bit-identical (the corruption never entered committed state).
+
+    **control leg** — equal-length soak, sentinel + fence armed, no
+    injection: ``divergence_total`` must be exactly 0 — committed state
+    is bit-identical by construction, so any latch here is a false
+    positive."""
+    import urllib.request
+
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.telemetry.events import read_trail
+
+    def leg(name: str, inject: bool, fence: bool):
+        """Returns (error, lighthouse_status, trails, sums)."""
+        wd = os.path.join(workdir, name)
+        os.makedirs(wd, exist_ok=True)
+        os.makedirs(os.path.join(wd, "evidence"), exist_ok=True)
+        with open(os.path.join(wd, "corpus.bin"), "wb") as f:
+            f.write(bytes(range(256)) * 24)
+        lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+        addr = lighthouse.address().split("//", 1)[-1]
+        status: Dict = {}
+        err: Optional[str] = None
+        try:
+            procs = {}
+            for gid in (0, 1):
+                env = dict(extra_env or {})
+                env.update(_worker_env(scn, gid))
+                if not inject:
+                    env.pop("TORCHFT_FAULT_SCHEDULE", None)
+                if fence:
+                    env["TORCHFT_DIVERGENCE_FENCE"] = "1"
+                procs[gid] = _spawn(gid, addr, wd, steps, env, worker_argv)
+            deadline = time.monotonic() + timeout_s
+            while True:
+                done = {g: p.poll() for g, p in procs.items()}
+                for gid, rc in done.items():
+                    if rc is not None and rc != 0:
+                        err = (f"{name}: g{gid} rc={rc}; log tail: "
+                               f"{_read_log(wd, gid)[-1000:]}")
+                if err or all(rc is not None for rc in done.values()):
+                    break
+                if time.monotonic() > deadline:
+                    err = f"{name}: timeout after {timeout_s}s"
+                    break
+                time.sleep(0.5)
+            # scrape the divergence latch BEFORE the lighthouse dies —
+            # the counter lives in the coordinator, not the workers
+            try:
+                with urllib.request.urlopen(
+                    f"http://{addr}/status.json", timeout=5
+                ) as resp:
+                    status = json.loads(resp.read().decode())
+            except Exception as e:  # noqa: BLE001
+                err = err or f"{name}: lighthouse scrape failed: {e}"
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+            lighthouse.shutdown()
+        trails = {
+            gid: read_trail(os.path.join(wd, f"trail{gid}.jsonl"))
+            for gid in (0, 1)
+        }
+        sums: List[str] = []
+        for gid in (0, 1):
+            m = re.findall(
+                r"param_checksum=(-?[\d.]+|nan|inf)", _read_log(wd, gid)
+            )
+            sums.append(m[-1] if m else "")
+        return err, status, trails, sums
+
+    # -- sentinel leg: the corrupt average commits, the latch must fire
+    err, status, trails, sums = leg("sentinel", inject=True, fence=False)
+    if err:
+        return Result(scn.name, "failed", err)
+    if int(status.get("divergence_total", 0)) < 1:
+        return Result(
+            scn.name, "failed",
+            f"corrupt output committed but the sentinel never latched "
+            f"(divergence_total={status.get('divergence_total')})",
+        )
+    all_events = [r for t in trails.values() for r in t]
+    # the trail's fault_injected record carries no step (the plane is
+    # step-agnostic), but its BLACK-BOX mirror is stamped with the
+    # Manager's step context — read the injection's step coordinate
+    # from the crash-durable ring, which is exactly what it is for
+    from torchft_tpu.telemetry.postmortem import collect_boxes
+
+    corrupt_steps = [
+        r.get("st")
+        for b in collect_boxes(os.path.join(workdir, "sentinel", "blackbox"))
+        for r in b["records"]
+        if r.get("k") == "fault_injected" and r.get("action") == "corrupt"
+    ]
+    injected_steps = [
+        s for s in corrupt_steps if isinstance(s, int) and s >= 0
+    ]
+    detected = sorted(
+        r.get("step", 10**9)
+        for r in all_events
+        if r.get("event") == "divergence_detected"
+    )
+    if not detected:
+        return Result(
+            scn.name, "failed",
+            "lighthouse latched but no worker trail carries "
+            "divergence_detected (reply flag never surfaced)",
+        )
+    # "within one commit": the injection fired on the 5th allreduce
+    # (~step 4); the latch must be visible by the following commit
+    corrupt_step = min(injected_steps) if injected_steps else None
+    if corrupt_step is not None and detected[0] > corrupt_step + 1:
+        return Result(
+            scn.name, "failed",
+            f"sentinel latched at step {detected[0]}, more than one "
+            f"commit after the injection at step {corrupt_step}",
+        )
+    if any(s in ("nan", "inf", "") for s in sums):
+        return Result(
+            scn.name, "failed",
+            f"sentinel leg produced non-finite/missing checksums {sums}",
+        )
+
+    # -- fence leg: the corrupt commit must abort; checksums identical
+    err, status, trails, sums = leg("fence", inject=True, fence=True)
+    if err:
+        return Result(scn.name, "failed", err)
+    if int(status.get("divergence_total", 0)) < 1:
+        return Result(
+            scn.name, "failed",
+            f"fence leg: sentinel never latched "
+            f"(divergence_total={status.get('divergence_total')})",
+        )
+    aborts = [
+        r for t in trails.values() for r in t if r.get("event") == "abort"
+    ]
+    if not aborts:
+        return Result(
+            scn.name, "failed",
+            "fence leg: divergence latched but no abort recorded — the "
+            "fence did not veto the corrupt commit",
+        )
+    if any(s in ("nan", "inf", "") for s in sums) or sums[0] != sums[1]:
+        return Result(
+            scn.name, "failed",
+            f"fence leg: checksums {sums} — the vetoed corruption still "
+            "reached committed state",
+        )
+
+    # -- control leg: zero false positives (digests identical by
+    # construction on every committed step)
+    err, status, _trails, sums = leg("control", inject=False, fence=True)
+    if err:
+        return Result(scn.name, "failed", err)
+    if int(status.get("divergence_total", 0)) != 0:
+        return Result(
+            scn.name, "failed",
+            f"control soak FALSE POSITIVE: divergence_total="
+            f"{status.get('divergence_total')} with no injection",
+        )
+    if any(s in ("nan", "inf", "") for s in sums) or sums[0] != sums[1]:
+        return Result(
+            scn.name, "failed",
+            f"control leg checksums {sums}",
+        )
+    return Result(
+        scn.name, "passed",
+        f"sentinel latched at step {detected[0]} (corrupt at "
+        f"{corrupt_step}); fence aborted with identical checksums "
+        f"{sums[0]}; control soak clean",
+    )
+
+
+def collect_postmortem(workdir: str, detail: str = "") -> Optional[str]:
+    """Auto-forensics on scenario failure: merge the run's black boxes,
+    trails and evidence into one postmortem report under the evidence
+    dir. Returns the report path (None when nothing could be written) —
+    best-effort by design, a broken postmortem must never mask the
+    scenario's own failure."""
+    try:
+        from torchft_tpu.telemetry import postmortem
+
+        evidence_dir = os.path.join(workdir, "evidence")
+        os.makedirs(evidence_dir, exist_ok=True)
+        logs = []
+        for path in sorted(glob.glob(os.path.join(workdir, "g*.log"))):
+            try:
+                with open(path, errors="replace") as f:
+                    logs.append(f.read()[-20000:])
+            except OSError:
+                pass
+        report = postmortem.analyze(workdir, log_text="\n".join(logs))
+        report["scenario_detail"] = detail
+        out = os.path.join(evidence_dir, "postmortem.json")
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, default=str)
+        print(f"    postmortem ({report['classification']}): {out}")
+        return out
+    except Exception as e:  # noqa: BLE001 — forensics must not mask failures
+        print(f"    postmortem collection failed: {e}")
+        return None
+
+
 # ---------------------------------------------------------------------------
 # sanitizer mode
 # ---------------------------------------------------------------------------
@@ -963,6 +1285,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                 scn, wd, steps=steps, timeout_s=args.timeout,
                 extra_env=extra_env, worker_argv=worker_argv,
             )
+        elif scn.name == "postmortem_kill_allreduce":
+            # standard kill run + the forensic assertion on the black
+            # boxes alone (sanitize-capable — same worker argv)
+            res = run_postmortem_scenario(
+                scn, wd, steps=steps, timeout_s=args.timeout,
+                extra_env=extra_env, worker_argv=worker_argv,
+            )
+        elif scn.name == "corrupt_divergence":
+            # three-leg sentinel/fence/control runner (sanitize-capable)
+            res = run_divergence_scenario(
+                scn, wd, steps=steps, timeout_s=args.timeout,
+                extra_env=extra_env, worker_argv=worker_argv,
+            )
         else:
             res = run_scenario(scn, wd, steps=steps, timeout_s=args.timeout,
                                extra_env=extra_env, worker_argv=worker_argv)
@@ -971,6 +1306,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"    {res.status.upper()} in {res_s:.1f}s "
             f"(fired={res.fired} respawns={res.respawns}) {res.detail}"
         )
+        if res.status != "passed":
+            # auto-forensics (ISSUE 10): a failing or environmental run
+            # leaves a merged postmortem report next to its evidence, so
+            # triage starts from a reconstructed timeline instead of raw
+            # logs — environmental skips become triaged artifacts
+            collect_postmortem(wd, detail=res.detail)
         results.append(res)
 
     report = {

@@ -76,6 +76,8 @@ class _PendingCommit:
         "errored",
         "prepare_s",
         "on_resolved",
+        "digest",
+        "epoch",
     )
 
     def __init__(self) -> None:
@@ -88,6 +90,10 @@ class _PendingCommit:
         self.errored: Optional[Exception] = None
         self.prepare_s = 0.0
         self.on_resolved: Optional[Callable[[bool], None]] = None
+        # divergence sentinel: this step's folded post-reduce digest and
+        # the plane epoch it was reduced under (docs/observability.md)
+        self.digest: Optional[str] = None
+        self.epoch = -1
 
 
 class WorldSizeMode(Enum):
@@ -398,6 +404,32 @@ class Manager:
         telemetry.TRACER.set_context(
             replica_id=self._replica_id, step=self._step, quorum_epoch=-1
         )
+        # crash-durable black box (docs/observability.md "Forensics"):
+        # keep its (replica, step, epoch) context in lockstep with the
+        # tracer's so every mirrored record carries the clock-sync-free
+        # coordinates the postmortem merge orders by
+        telemetry.BLACKBOX.set_context(
+            replica_id=self._replica_id, step=self._step, quorum_epoch=-1
+        )
+
+        # Divergence sentinel (docs/observability.md): digest the step's
+        # post-reduce state and let the lighthouse compare it within the
+        # (epoch, step) cohort at the commit boundary. The fence
+        # (TORCHFT_DIVERGENCE_FENCE=1, implies the sentinel) additionally
+        # vetoes the commit on a mismatch — all groups must agree on the
+        # fence, like commit_pipeline. Off by default: hashing every
+        # reduced buffer is not free.
+        self._divergence_fence = (
+            os.environ.get("TORCHFT_DIVERGENCE_FENCE", "0") == "1"
+        )
+        self._divergence_sentinel = self._divergence_fence or (
+            os.environ.get("TORCHFT_DIVERGENCE_SENTINEL", "0") == "1"
+        )
+        # ordered per-op tree digests of this step's reduced outputs;
+        # appended on the op-callback thread (ops resolve in issue order
+        # — the op thread is serial), folded + cleared at _prepare_commit
+        self._step_digests: List[str] = []
+        self._divergence_latched = False
 
     def _on_stall(self, step: int, elapsed_s: float, threshold_s: float) -> None:
         """Watchdog stall callback (watchdog thread): ship the stuck
@@ -663,6 +695,11 @@ class Manager:
             step=self._step_label,
             quorum_epoch=self._quorum_id,
         )
+        telemetry.BLACKBOX.set_context(
+            replica_id=self._replica_id,
+            step=self._step_label,
+            quorum_epoch=self._quorum_id,
+        )
         self._watchdog.arm(self._step_label)
         telemetry.emit(
             "quorum_start",
@@ -868,6 +905,7 @@ class Manager:
                 )
             self._quorum_id = quorum.quorum_id
             telemetry.TRACER.set_context(quorum_epoch=quorum.quorum_id)
+            telemetry.BLACKBOX.set_context(quorum_epoch=quorum.quorum_id)
             telemetry.QUORUM_RECONFIGURES.inc()
             self.step_timer.mark_quorum()
             # fresh epoch: the flush request (if any) has been honored
@@ -1211,12 +1249,14 @@ class Manager:
                     e._tft_participants = ids_snapshot
                     raise
                 n = n_at_issue
-                if n <= 1:
-                    return reduced  # dividing by 1 would only cost a kernel
-                if device:
-                    return _divide_tree(reduced, n)
-                for t in reduced:
-                    np.divide(t, n, out=t)
+                if n > 1:
+                    if device:
+                        reduced = _divide_tree(reduced, n)
+                    else:
+                        for t in reduced:
+                            np.divide(t, n, out=t)
+                if self._divergence_sentinel:
+                    self._digest_reduced(reduced)
                 return reduced
 
             fut = self.wrap_future(work.get_future().then(normalize), tensors)
@@ -1229,6 +1269,40 @@ class Manager:
             self._logger.exception(f"exception in allreduce, skipping remaining: {e}")
             self.report_error(e)
             return Future.completed(tensors)
+
+    def _digest_reduced(self, reduced: List[Any]) -> None:
+        """Divergence sentinel: fold one op's post-reduce outputs into
+        this step's ordered digest list (op-callback thread; ops resolve
+        in issue order, so the list is deterministic across groups —
+        which is what makes equality the invariant). blake2b via the
+        differential-heal digest helpers; failures degrade to "no digest
+        this step", never to a failed op."""
+        try:
+            from torchft_tpu.checkpointing import delta as _delta
+
+            bufs = [np.asarray(t) for t in reduced]
+            self._step_digests.append(
+                _delta.tree_digest(_delta.leaf_digests(bufs))
+            )
+        except Exception:  # noqa: BLE001 — sentinel must not fail the op
+            self._logger.exception("divergence digest failed")
+
+    def _note_divergence(self, step: int) -> None:
+        """The should_commit reply carried the lighthouse's divergence
+        latch: record it once per process (the lighthouse latch never
+        clears, so every later vote re-reports it)."""
+        if self._divergence_latched:
+            return
+        self._divergence_latched = True
+        telemetry.DIVERGENCE_TOTAL.inc()
+        telemetry.emit(
+            "divergence_detected", step=step, fence=self._divergence_fence
+        )
+        self._logger.warn(
+            f"divergence sentinel latched at step {step}: post-reduce "
+            "state digests disagreed across the cohort"
+            + (" (fence vetoed the commit)" if self._divergence_fence else "")
+        )
 
     def report_error(self, e: Exception) -> None:
         """Latch an error: the current step will not commit and the data
@@ -1502,6 +1576,26 @@ class Manager:
         rec.local_vote = (
             rec.enough_replicas and self._errored is None and not rec.mixed_epochs
         )
+        # divergence sentinel: fold the step's ordered per-op digests
+        # into ONE step digest (delta.py's tree fold) and clear for the
+        # next step; the vote RPC piggybacks it to the lighthouse's
+        # (epoch, step) cohort compare. A step that is not committing
+        # cleanly here (error latched / incomplete digest coverage)
+        # ABSTAINS ("-"): it still completes the cohort so peers' fence
+        # waits never stall on an aborting group, but its partial digest
+        # never enters the comparison — only committing states must
+        # agree, and an aborting step commits nothing to diverge.
+        rec.epoch = self._quorum_id
+        if self._divergence_sentinel:
+            rec.digest = "-"
+            if rec.local_vote and self._step_digests:
+                try:
+                    from torchft_tpu.checkpointing import delta as _delta
+
+                    rec.digest = _delta.tree_digest(self._step_digests)
+                except Exception:  # noqa: BLE001 — degrade to abstain
+                    rec.digest = "-"
+        self._step_digests = []
 
         if self._errored is not None and self._errored_epoch == self._quorum_id:
             # the data plane is suspect: request a flush so the next quorum
@@ -1608,8 +1702,14 @@ class Manager:
                 rec.step,
                 rec.local_vote,
                 timeout=timeout or self._timeout,
+                digest=rec.digest,
+                epoch=rec.epoch,
+                fence=self._divergence_fence,
             )
             sc_span.set(decision=should_commit)
+        # getattr: duck-typed test managers may predate the sentinel
+        if getattr(self._client, "last_divergence", False) is True:
+            self._note_divergence(rec.step)
         self._finish_commit(
             rec, should_commit, _time.perf_counter() - t_commit
         )
@@ -1668,9 +1768,13 @@ class Manager:
                 pipelined=True,
             ) as sc_span:
                 decision = self._commit_client.should_commit(
-                    self._rank, rec.step, rec.local_vote, timeout=vote_timeout
+                    self._rank, rec.step, rec.local_vote, timeout=vote_timeout,
+                    digest=rec.digest, epoch=rec.epoch,
+                    fence=self._divergence_fence,
                 )
                 sc_span.set(decision=decision)
+            if getattr(self._commit_client, "last_divergence", False) is True:
+                self._note_divergence(rec.step)
             return decision
 
         rec.future = run_in_executor(self._commit_executor, vote)

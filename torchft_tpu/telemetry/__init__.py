@@ -37,6 +37,12 @@ from torchft_tpu.telemetry.anatomy import (
     StepLedger,
     merge_lathist,
 )
+from torchft_tpu.telemetry.blackbox import (
+    BLACKBOX,
+    BlackBox,
+    read_blackbox,
+    read_native_blackbox,
+)
 from torchft_tpu.telemetry.events import (
     CANONICAL_EVENTS,
     ENV_TRAIL_PATH,
@@ -63,6 +69,10 @@ __all__ = [
     "EVENTS",
     "TRACER",
     "FLIGHT",
+    "BLACKBOX",
+    "BlackBox",
+    "read_blackbox",
+    "read_native_blackbox",
     "LEDGER",
     "LOG2_BUCKETS",
     "PHASES",
@@ -280,6 +290,17 @@ STEP_LOCAL_SECONDS = REGISTRY.histogram(
     "quorum_wait, commit_barrier, heal) — the straggler-discriminating "
     "signal piggybacked to the lighthouse",
     buckets=LOG2_BUCKETS,
+)
+
+# divergence sentinel (ISSUE 10): cross-group post-reduce digest
+# mismatches latched by the lighthouse's (epoch, step) cohort compare,
+# observed replica-side on the should_commit reply — the corrupt-commit
+# failure mode surfaced at the commit boundary instead of at the nan
+DIVERGENCE_TOTAL = REGISTRY.counter(
+    "tft_divergence_total",
+    "Commit-time state-digest divergence latches observed by this "
+    "replica (the lighthouse's cohort compare disagreed — see "
+    "docs/observability.md 'Divergence sentinel')",
 )
 
 # SLO / straggler plane (telemetry/slo.py)

@@ -279,6 +279,15 @@ class StepLedger:
         with self._lock:
             self._totals["idle"] = self._totals.get("idle", 0.0) + interval["idle"]
             self._rows.append(row)
+        # crash-durable mirror: one compact tick per step row, so the
+        # postmortem can place a death between two step boundaries even
+        # with every in-memory surface gone
+        from torchft_tpu.telemetry.blackbox import BLACKBOX
+
+        BLACKBOX.record(
+            "anatomy_tick", step=step, wall_s=round(wall, 6),
+            local_s=round(local, 6),
+        )
         try:
             from torchft_tpu import telemetry
 

@@ -66,6 +66,8 @@ CANONICAL_EVENTS = (
     "slo_recovered",
     "straggler_detected",
     "straggler_cleared",
+    "divergence_detected",
+    "blackbox_recovered",
 )
 
 
@@ -193,6 +195,12 @@ class EventTrail:
                     self._maybe_rotate()
                 except (OSError, ValueError):
                     pass  # a full disk must not fail a step
+        # crash-durable mirror: the black box keeps the trail readable
+        # even when this process is SIGKILLed with the file sink unset
+        # (or mid-line) — see telemetry/blackbox.py
+        from torchft_tpu.telemetry.blackbox import BLACKBOX
+
+        BLACKBOX.record(event, **fields)
         # metric alongside the trail so dashboards can rate() FT events
         # without parsing JSONL (late import avoids a module cycle)
         from torchft_tpu.telemetry import FT_EVENTS_TOTAL

@@ -109,7 +109,17 @@ class FlightRecorder:
             self._seq += 1
             rec["seq"] = self._seq
             self._ring[self._seq % self._size] = rec
-            return self._seq
+            seq = self._seq
+        # crash-durable mirror: the in-memory ring dies with the process,
+        # so the black box records every issue/complete — a SIGKILLed
+        # worker's "last in-flight op" is recoverable from disk alone
+        from torchft_tpu.telemetry.blackbox import BLACKBOX
+
+        BLACKBOX.record(
+            "op_issue", op=op, plane=plane, fseq=seq,
+            bytes=int(nbytes), tag=tag, rank=rank,
+        )
+        return seq
 
     def record_complete(self, seq: int, error: Optional[BaseException] = None) -> None:
         with self._lock:
@@ -120,6 +130,13 @@ class FlightRecorder:
             rec["status"] = "completed" if error is None else "failed"
             if error is not None:
                 rec["error"] = repr(error)
+        from torchft_tpu.telemetry.blackbox import BLACKBOX
+
+        BLACKBOX.record(
+            "op_complete", fseq=seq,
+            status="completed" if error is None else "failed",
+            **({"error": repr(error)} if error is not None else {}),
+        )
 
     # -- consumer side ---------------------------------------------------
 
