@@ -545,15 +545,17 @@ def main() -> None:
         extra["quorum_overlap"] = {"error": str(e)}
 
     # quorum fan-out p50/p99 vs group count (ISSUE 10 satellite — the
-    # measurement the ROADMAP HA open item names): N in-process manager
-    # servers against one lighthouse, read off the PR 8 native
+    # measurement the ROADMAP HA open item names, extended to 128/256 in
+    # ISSUE 11 per the ROADMAP's explicit 256+ ask): N in-process
+    # manager servers against one lighthouse, read off the PR 8 native
     # quorum.fanout latency histogram. Own process so the N-group
     # lathist never contaminates this process's step-anatomy row.
     try:
         extra.update(
             _run_json_subprocess(
                 [sys.executable, "-m", "torchft_tpu.benchmarks.quorum_scale"],
-                timeout_s=600,
+                # 256 servers' worth of thread/boot time on a small box
+                timeout_s=1200,
                 env_extra={"JAX_PLATFORMS": "cpu"},
             )
         )

@@ -15,6 +15,7 @@
 #include "coord.h"
 #include "lathist.h"
 #include "rpc.h"
+#include "tsdb.h"
 #include "wire.h"
 
 using namespace tft;
@@ -316,6 +317,45 @@ int64_t tft_lathist_snapshot(uint8_t** out, int64_t* outlen, char* err,
 }
 
 void tft_lathist_reset() { lathist::reset_all(); }
+
+// ---- time-series store (tsdb.h) ----
+
+// Snapshot THIS process's tsdb store (the in-process lighthouse's sample
+// rings) as an encoded Value map:
+//   { "<replica>": { "<series>": { "samples": [[epoch, step, value]...] } } }
+// Oldest-first per series — the test surface behind /timeseries.json.
+int64_t tft_tsdb_snapshot(uint8_t** out, int64_t* outlen, char* err,
+                          int errlen) {
+  try {
+    Value resp = Value::M();
+    auto dump = tsdb::store().dump();
+    for (const auto& [rid, series] : dump) {
+      Value rv = Value::M();
+      for (const auto& [name, samples] : series) {
+        Value sv = Value::M();
+        Value l = Value::L();
+        for (const auto& s : samples) {
+          Value p = Value::L();
+          p.list.push_back(Value::I(s.epoch));
+          p.list.push_back(Value::I(s.step));
+          p.list.push_back(Value::F(s.value));
+          l.list.push_back(p);
+        }
+        sv.set("samples", l);
+        rv.set(name, sv);
+      }
+      resp.set(rid, rv);
+    }
+    std::string enc = encode(resp);
+    *out = alloc_out(enc, outlen);
+    return OK;
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return INTERNAL;
+  }
+}
+
+void tft_tsdb_reset() { tsdb::store().reset(); }
 
 // quorum_buf encodes a Quorum value. Response: ManagerQuorumResult map.
 int64_t tft_compute_quorum_results(const uint8_t* quorum_buf, int64_t len,

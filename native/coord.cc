@@ -4,13 +4,16 @@
 
 #include <algorithm>
 #include <chrono>
+#include <cmath>
 #include <cstdio>
 #include <cstdlib>
+#include <iterator>
 #include <sstream>
 
 #include "blackbox.h"     // crash-durable quorum/commit breadcrumbs
 #include "faultinject.h"  // env-gated injection points (reply delay/drop)
 #include "lathist.h"      // quorum.fanout latency histogram + exports
+#include "tsdb.h"         // fixed-retention (replica, series) sample rings
 
 namespace tft {
 
@@ -561,11 +564,44 @@ void Lighthouse::ingest_telemetry(const std::string& replica_id,
     t.summary_json = std::move(summary);
   // step-anatomy digest: same verbatim-splice contract as the summary
   // (the lighthouse never parses the Python telemetry schema); size-
-  // capped — a malformed reporter must not grow the coordinator's store
+  // capped — a malformed reporter must not grow the coordinator's store.
+  // Oversize degrades LOUDLY: the digest (and any stale predecessor) is
+  // dropped and counted, never truncated into /cluster.json (ISSUE 11).
   std::string anatomy = v.gets("anatomy");
-  if (!anatomy.empty() && anatomy.size() <= (1u << 16) &&
-      anatomy.front() == '{' && anatomy.back() == '}')
-    t.anatomy_json = std::move(anatomy);
+  if (!anatomy.empty()) {
+    if (anatomy.size() > (1u << 16)) {
+      t.anatomy_json.clear();
+      t.anatomy_oversized++;
+      telemetry_oversized_total_++;
+      logline("telemetry from " + replica_id + ": anatomy digest " +
+              std::to_string(anatomy.size()) +
+              " bytes exceeds the 64KiB piggyback cap — dropped (not "
+              "truncated)");
+    } else if (anatomy.front() == '{' && anatomy.back() == '}') {
+      t.anatomy_json = std::move(anatomy);
+    }
+  }
+  // time-series ingest (ISSUE 11): an opaque {series-name: double} map
+  // sampled at the report's (epoch, step) coordinates. The lighthouse
+  // stays schema-blind — names mean whatever the Python side says.
+  if (v.has("series") && v.at("series").type == Value::Type::MAP) {
+    std::map<std::string, double> samples;
+    for (const auto& [name, sv] : v.at("series").map) {
+      if (sv.type == Value::Type::F64)
+        samples[name] = sv.f;
+      else if (sv.type == Value::Type::I64)
+        samples[name] = (double)sv.i;
+      else if (sv.type == Value::Type::BOOL)
+        samples[name] = sv.b ? 1.0 : 0.0;
+    }
+    // refuse non-finite samples at the door: %.9g would render them as
+    // "inf"/"nan" — INVALID JSON — and one bad report would blind every
+    // /timeseries.json consumer for the whole retention window
+    for (auto it = samples.begin(); it != samples.end();)
+      it = std::isfinite(it->second) ? std::next(it) : samples.erase(it);
+    tsdb::store().ingest(replica_id, v.geti("epoch", -1),
+                         v.geti("step", -1), samples);
+  }
   std::string spans = v.gets("spans");
   if (!spans.empty() && spans.size() <= kMaxSpanBytesPerReplica) {
     t.span_batches.push_back(std::move(spans));
@@ -806,7 +842,7 @@ std::string Lighthouse::status_html() {
     // training loop refreshes it every step).
     o << "<h2>Replica health</h2><table border=1 cellpadding=4>"
          "<tr><th>replica_id</th><th>last report</th><th>step</th>"
-         "<th>last heal</th><th>local p50</th><th>stuck</th>"
+         "<th>last heal</th><th>local p50</th><th>trend</th><th>stuck</th>"
          "<th>SLO</th><th>digest</th></tr>";
     // two clocks on purpose: report ages use the monotonic clock that
     // stamped last_ms (mixing in wall time would show epoch-offset
@@ -822,7 +858,13 @@ std::string Lighthouse::status_html() {
         o << (wall_now_s - t.last_heal_ts) << "s ago";
       else
         o << "never";
+      // sparkline over the retained local-step series (tsdb, ISSUE 11):
+      // the dashboard answers "when did this replica get slow" at a
+      // glance instead of only showing the instantaneous p50
+      std::string trend = tsdb::store().spark(id, "local_s", 32);
+      if (trend.empty()) trend = tsdb::store().spark(id, "local_p50_s", 32);
       o << "</td><td>" << t.local_step_p50_s << "s</td><td>"
+        << (trend.empty() ? "-" : trend) << "</td><td>"
         << (t.stuck ? "STUCK" : "ok")
         // the burn-rate SLO column (ISSUE 8): red next to the PR 2 STUCK
         // flag, driven by the replica-side evaluator's piggybacked latch
@@ -892,6 +934,7 @@ std::string Lighthouse::cluster_json() {
       << (t.summary_json.empty() ? "{}" : t.summary_json)
       << ",\"anatomy\":"
       << (t.anatomy_json.empty() ? "{}" : t.anatomy_json)
+      << ",\"anatomy_oversized\":" << t.anatomy_oversized
       << ",\"heartbeat_ms_ago\":";
     auto hb = state_.heartbeats.find(id);
     if (hb != state_.heartbeats.end())
@@ -940,6 +983,41 @@ std::string Lighthouse::handle_http(const std::string& method,
   if (method == "GET" && path == "/status") return http_ok(status_html());
   if (method == "GET" && path == "/cluster.json")
     return http_ok(cluster_json(), "application/json");
+  // Range queries over the retained time series (ISSUE 11). Query
+  // params: replica=<substr> series=<substr> since=<step, exclusive>
+  // max_points=<downsample cap per series>. The `cursor.max_step` in
+  // the reply is the next `since` for an incremental consumer.
+  if (method == "GET" && path.rfind("/timeseries.json", 0) == 0) {
+    std::string replica_f, series_f;
+    int64_t since = -1;
+    size_t max_points = 0;
+    auto qpos = path.find('?');
+    if (qpos != std::string::npos) {
+      std::string qs = path.substr(qpos + 1);
+      size_t start = 0;
+      while (start < qs.size()) {
+        size_t amp = qs.find('&', start);
+        std::string kv = qs.substr(
+            start, amp == std::string::npos ? std::string::npos
+                                            : amp - start);
+        auto eq = kv.find('=');
+        if (eq != std::string::npos) {
+          std::string k = kv.substr(0, eq), v = kv.substr(eq + 1);
+          if (k == "replica") replica_f = v;
+          else if (k == "series") series_f = v;
+          else if (k == "since") since = strtoll(v.c_str(), nullptr, 10);
+          else if (k == "max_points")
+            max_points = (size_t)strtoul(v.c_str(), nullptr, 10);
+        }
+        if (amp == std::string::npos) break;
+        start = amp + 1;
+      }
+    }
+    return http_ok(
+        tsdb::store().render_json(replica_f, series_f, since, max_points,
+                                  wall_ms(), json_escape),
+        "application/json");
+  }
   if (method == "GET" && path == "/trace")
     return http_ok(merged_trace_json(), "application/json");
   if (method == "GET" && path == "/metrics") {
@@ -982,6 +1060,15 @@ std::string Lighthouse::handle_http(const std::string& method,
       << "torchft_evictions_total " << evictions_total_ << "\n"
       << "# TYPE torchft_flush_requests_total counter\n"
       << "torchft_flush_requests_total " << flush_requests_total_ << "\n"
+      // loud-degrade counters (ISSUE 11): oversized anatomy digests
+      // dropped at the 64KiB piggyback cap, and series past the per-
+      // replica TSDB fan-out cap — silence here would mean silent loss
+      << "# TYPE torchft_telemetry_oversized_total counter\n"
+      << "torchft_telemetry_oversized_total " << telemetry_oversized_total_
+      << "\n"
+      << "# TYPE torchft_tsdb_dropped_series_total counter\n"
+      << "torchft_tsdb_dropped_series_total "
+      << tsdb::store().dropped_series() << "\n"
       << "# TYPE torchft_divergence_total counter\n"
       << "torchft_divergence_total " << divergence_total_ << "\n"
       << "# TYPE torchft_divergence_detected gauge\n"

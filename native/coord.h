@@ -142,6 +142,11 @@ struct ReplicaTelemetry {
   bool slo_breach = false;
   std::string summary_json; // compact counters digest (JSON object)
   std::string anatomy_json; // per-phase step-anatomy digest (JSON object)
+  // Reports whose anatomy digest exceeded the 64 KiB piggyback cap: the
+  // digest is DROPPED (never truncated into /cluster.json — a sliced
+  // JSON object would parse as garbage downstream) and this counter
+  // makes the drop loud on /cluster.json + /metrics (ISSUE 11).
+  int64_t anatomy_oversized = 0;
   std::vector<std::string> span_batches;  // chrome trace-event fragments
   size_t span_bytes = 0;    // bytes across span_batches (for the cap)
 };
@@ -213,6 +218,9 @@ class Lighthouse {
   // Cluster telemetry aggregation (PR 2): per-replica rolling store fed by
   // piggybacked reports, served at /cluster.json and merged at /trace.
   std::map<std::string, ReplicaTelemetry> telemetry_;
+  // Oversized-digest drops across all replicas (loud-degrade counter for
+  // the 64 KiB piggyback cap; per-replica counts live in telemetry_).
+  int64_t telemetry_oversized_total_ = 0;
   // Divergence sentinel (ISSUE 10): commit-time digest rounds keyed by
   // (epoch, step). Every committed step's post-reduce state is
   // bit-identical across the cohort by construction, so two distinct

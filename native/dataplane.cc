@@ -983,7 +983,7 @@ extern "C" {
 // build and rebuilds in place.
 // v5: mgr.should_commit carries divergence-sentinel digests, lh.digest
 // RPC added, native blackbox breadcrumbs (blackbox.h) compiled in.
-int tft_abi_version() { return 5; }
+int tft_abi_version() { return 6; }
 
 int64_t tft_dp_create(int rank, int world, int nstripes, char* err,
                       int errlen) {

@@ -1,0 +1,298 @@
+// torchft_tpu native core — fixed-retention time-series store (ISSUE 11).
+//
+// Every observability surface before this one was either instantaneous
+// (/metrics, /cluster.json hold each replica's LATEST report) or post-hoc
+// (black box, postmortem). This store is the missing axis: a bounded ring
+// of samples per (replica, series) on the lighthouse, fed by the SAME
+// quorum-piggyback telemetry the cluster aggregation already ingests, so
+// "when did the fleet get slow" is answerable from one range query.
+//
+// Design constraints, in order:
+//   * samples are keyed by (epoch, step) — the clock-sync-free coordinates
+//     everything else in this repo orders by — never by wall time;
+//   * the lighthouse stays SCHEMA-BLIND: a sample is an opaque series
+//     name (string) plus one double; the Python replica decides what to
+//     publish (telemetry/timeseries.py builds the map), so the Python
+//     telemetry schema evolves without touching the C++ core — the same
+//     contract as the verbatim-spliced summary/anatomy digests;
+//   * fixed retention (TORCHFT_TSDB_RETAIN samples per series) and fixed
+//     fan-out caps (TORCHFT_TSDB_MAX_SERIES per replica, 256 replicas):
+//     a chatty or malicious reporter must never OOM the coordinator;
+//   * rings for dead replicas are RETAINED (up to the replica cap): the
+//     history of a killed group is exactly what the postmortem needs, and
+//     a respawned group (fresh uuid suffix) gets its own ring — so
+//     /timeseries.json serves the full history across a kill/respawn.
+//
+// One process-global store (like lathist.h): the lighthouse ingests under
+// its own mutex here (a leaf lock — never taken while holding another),
+// tests snapshot it through the C ABI (tft_tsdb_snapshot), and the HTTP
+// side renders range queries (since-step cursor, stride downsampling).
+#pragma once
+
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace tft {
+namespace tsdb {
+
+inline long env_long(const char* name, long dflt) {
+  const char* v = getenv(name);
+  if (!v || !*v) return dflt;
+  char* end = nullptr;
+  long out = strtol(v, &end, 10);
+  return (end && *end == '\0') ? out : dflt;
+}
+
+struct Sample {
+  int64_t epoch = -1;
+  int64_t step = -1;
+  double value = 0.0;
+};
+
+// One bounded ring of samples, oldest evicted first. A report repeating
+// the step of the previous sample OVERWRITES it (reports ride every
+// quorum RPC; a re-quorum within one step must not burn retention), and
+// out-of-order steps append normally — a respawned process restarting at
+// step 0 legitimately goes backwards before its heal jumps it forward.
+struct Ring {
+  std::vector<Sample> buf;
+  size_t cap = 0;
+  size_t next = 0;   // insertion cursor
+  bool full = false;
+  int64_t last_step = INT64_MIN;
+  size_t last_idx = 0;
+  uint64_t total = 0;  // samples ever ingested (evictions included)
+
+  void add(const Sample& s) {
+    if (cap == 0) return;
+    if (!buf.empty() && s.step == last_step && s.step >= 0) {
+      buf[last_idx] = s;  // refresh, don't burn retention
+      return;
+    }
+    if (buf.size() < cap) {
+      last_idx = buf.size();
+      buf.push_back(s);
+      next = buf.size() % cap;
+      full = buf.size() == cap;
+    } else {
+      last_idx = next;
+      buf[next] = s;
+      next = (next + 1) % cap;
+      full = true;
+    }
+    last_step = s.step;
+    total++;
+  }
+
+  // oldest-first copy
+  std::vector<Sample> ordered() const {
+    std::vector<Sample> out;
+    out.reserve(buf.size());
+    if (full && !buf.empty()) {
+      for (size_t i = 0; i < buf.size(); i++)
+        out.push_back(buf[(next + i) % buf.size()]);
+    } else {
+      out = buf;
+    }
+    return out;
+  }
+};
+
+class Store {
+ public:
+  Store()
+      : retain_((size_t)env_long("TORCHFT_TSDB_RETAIN", 512)),
+        max_series_((size_t)env_long("TORCHFT_TSDB_MAX_SERIES", 64)) {}
+
+  size_t retain() const { return retain_; }
+
+  // One replica report's worth of samples, all at (epoch, step).
+  void ingest(const std::string& replica, int64_t epoch, int64_t step,
+              const std::map<std::string, double>& values) {
+    if (step < 0 || values.empty()) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto rit = data_.find(replica);
+    if (rit == data_.end()) {
+      if (data_.size() >= kMaxReplicas) {
+        // evict the replica whose newest sample is stalest — dead uuids
+        // from long-gone respawn generations go first, and the CURRENT
+        // incident's rings (actively written) are never the minimum
+        auto oldest = data_.begin();
+        uint64_t oldest_seq = UINT64_MAX;
+        for (auto it = data_.begin(); it != data_.end(); ++it) {
+          uint64_t seq = last_ingest_seq_.count(it->first)
+                             ? last_ingest_seq_[it->first]
+                             : 0;
+          if (seq < oldest_seq) {
+            oldest_seq = seq;
+            oldest = it;
+          }
+        }
+        last_ingest_seq_.erase(oldest->first);
+        data_.erase(oldest);
+      }
+      rit = data_.emplace(replica, std::map<std::string, Ring>{}).first;
+    }
+    last_ingest_seq_[replica] = ++ingest_seq_;
+    auto& series = rit->second;
+    for (const auto& [name, value] : values) {
+      auto sit = series.find(name);
+      if (sit == series.end()) {
+        if (series.size() >= max_series_) {
+          dropped_series_++;  // loud on /metrics, never silent
+          continue;
+        }
+        sit = series.emplace(name, Ring{}).first;
+        sit->second.cap = retain_;
+        sit->second.buf.reserve(retain_ < 64 ? retain_ : 64);
+      }
+      sit->second.add(Sample{epoch, step, value});
+    }
+  }
+
+  uint64_t dropped_series() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return dropped_series_;
+  }
+
+  // Full ordered copy (C-ABI snapshot + tests).
+  std::map<std::string, std::map<std::string, std::vector<Sample>>> dump()
+      const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::map<std::string, std::map<std::string, std::vector<Sample>>> out;
+    for (const auto& [rid, series] : data_)
+      for (const auto& [name, ring] : series)
+        out[rid][name] = ring.ordered();
+    return out;
+  }
+
+  void reset() {
+    std::lock_guard<std::mutex> g(mu_);
+    data_.clear();
+    last_ingest_seq_.clear();
+    dropped_series_ = 0;
+  }
+
+  // Range-query JSON for GET /timeseries.json. Filters: substring match
+  // on replica/series (empty = all), since = exclusive step cursor,
+  // max_points = stride-downsample cap per series (0 = raw; the LAST
+  // sample always survives so a cursor loop never misses the tip).
+  // json_escape is injected so this header stays independent of coord.cc.
+  template <typename Esc>
+  std::string render_json(const std::string& replica_filter,
+                          const std::string& series_filter,
+                          int64_t since_step, size_t max_points,
+                          int64_t now_unix_ms, Esc json_escape) const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::ostringstream o;
+    char buf[64];
+    // cursor.max_step is documented as "the next `since` value": when a
+    // since-filtered query matches nothing new it must echo the cursor
+    // back, never regress to -1 (an idle fleet would reset incremental
+    // consumers into refetching the whole retention window)
+    int64_t fleet_max_step = since_step;
+    o << "{\"retain\":" << retain_ << ",\"now_unix_ms\":" << now_unix_ms
+      << ",\"dropped_series\":" << dropped_series_ << ",\"replicas\":{";
+    bool first_r = true;
+    for (const auto& [rid, series] : data_) {
+      if (!replica_filter.empty() &&
+          rid.find(replica_filter) == std::string::npos)
+        continue;
+      if (!first_r) o << ",";
+      first_r = false;
+      o << "\"" << json_escape(rid) << "\":{";
+      bool first_s = true;
+      for (const auto& [name, ring] : series) {
+        if (!series_filter.empty() &&
+            name.find(series_filter) == std::string::npos)
+          continue;
+        std::vector<Sample> all = ring.ordered();
+        std::vector<const Sample*> sel;
+        sel.reserve(all.size());
+        for (const auto& s : all)
+          if (s.step > since_step) sel.push_back(&s);
+        size_t stride = 1;
+        if (max_points > 0 && sel.size() > max_points)
+          stride = (sel.size() + max_points - 1) / max_points;
+        if (!first_s) o << ",";
+        first_s = false;
+        o << "\"" << json_escape(name) << "\":{\"count\":" << sel.size()
+          << ",\"total\":" << ring.total << ",\"stride\":" << stride
+          << ",\"samples\":[";
+        bool first_p = true;
+        for (size_t i = 0; i < sel.size(); i++) {
+          // stride-sample, but always keep the newest point: a since-
+          // cursor consumer advances from the tip it actually saw
+          if (i % stride != 0 && i != sel.size() - 1) continue;
+          if (!first_p) o << ",";
+          first_p = false;
+          snprintf(buf, sizeof buf, "%.9g", sel[i]->value);
+          o << "[" << sel[i]->epoch << "," << sel[i]->step << "," << buf
+            << "]";
+        }
+        o << "]}";
+        if (!sel.empty())
+          fleet_max_step =
+              fleet_max_step > sel.back()->step ? fleet_max_step
+                                                : sel.back()->step;
+      }
+      o << "}";
+    }
+    o << "},\"cursor\":{\"max_step\":" << fleet_max_step << "}}";
+    return o.str();
+  }
+
+  // Unicode sparkline of one series' newest `width` samples (dashboard
+  // trend column). Empty string when the series has no samples.
+  std::string spark(const std::string& replica, const std::string& name,
+                    size_t width) const {
+    static const char* kBlocks[] = {"▁", "▂", "▃", "▄",
+                                    "▅", "▆", "▇", "█"};
+    std::lock_guard<std::mutex> g(mu_);
+    auto rit = data_.find(replica);
+    if (rit == data_.end()) return "";
+    auto sit = rit->second.find(name);
+    if (sit == rit->second.end()) return "";
+    std::vector<Sample> all = sit->second.ordered();
+    if (all.empty()) return "";
+    size_t start = all.size() > width ? all.size() - width : 0;
+    double lo = all[start].value, hi = all[start].value;
+    for (size_t i = start; i < all.size(); i++) {
+      lo = all[i].value < lo ? all[i].value : lo;
+      hi = all[i].value > hi ? all[i].value : hi;
+    }
+    std::string out;
+    for (size_t i = start; i < all.size(); i++) {
+      int idx = hi > lo
+                    ? (int)((all[i].value - lo) / (hi - lo) * 7.0 + 0.5)
+                    : 0;
+      if (idx < 0) idx = 0;
+      if (idx > 7) idx = 7;
+      out += kBlocks[idx];
+    }
+    return out;
+  }
+
+ private:
+  static constexpr size_t kMaxReplicas = 256;
+  mutable std::mutex mu_;
+  size_t retain_;
+  size_t max_series_;
+  std::map<std::string, std::map<std::string, Ring>> data_;
+  std::map<std::string, uint64_t> last_ingest_seq_;
+  uint64_t ingest_seq_ = 0;
+  uint64_t dropped_series_ = 0;
+};
+
+inline Store& store() {
+  static Store s;
+  return s;
+}
+
+}  // namespace tsdb
+}  // namespace tft

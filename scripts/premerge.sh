@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Pre-merge gate — the checklist that used to live only as prose in
+# docs/static_analysis.md, as one runnable script (ISSUE 11):
+#
+#   1. the static-analysis gate  (python -m torchft_tpu.analysis)
+#   2. the native strict-warning build  (make -C native warn, -Werror)
+#   3. the quick faultmatrix subset  (runner --quick)
+#
+# Exit 0 = every gate clean. Each gate runs even if an earlier one
+# failed, so one invocation reports the full damage; the exit code is
+# the OR of the gates. Tier-1 pytest is NOT included here — it has its
+# own driver and a ~15 min budget; this script is the fast (<10 min)
+# "can I even propose this diff" check.
+#
+# Usage:
+#   scripts/premerge.sh              # all three gates
+#   scripts/premerge.sh --no-matrix  # skip the faultmatrix (seconds-fast)
+set -u -o pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO"
+
+RUN_MATRIX=1
+for arg in "$@"; do
+  case "$arg" in
+    --no-matrix) RUN_MATRIX=0 ;;
+    *) echo "unknown arg: $arg (known: --no-matrix)" >&2; exit 2 ;;
+  esac
+done
+
+rc=0
+fail() { echo "premerge: GATE FAILED: $1" >&2; rc=1; }
+
+echo "=== [1/3] static-analysis gate (python -m torchft_tpu.analysis) ==="
+if ! JAX_PLATFORMS=cpu python -m torchft_tpu.analysis; then
+  fail "analysis"
+fi
+
+echo "=== [2/3] native strict-warning build (make -C native warn) ==="
+if ! make -C native warn; then
+  fail "native warn"
+fi
+
+if [ "$RUN_MATRIX" = 1 ]; then
+  echo "=== [3/3] quick faultmatrix subset (runner --quick) ==="
+  if ! JAX_PLATFORMS=cpu python -m torchft_tpu.faultinject.runner --quick \
+      --outdir "${TMPDIR:-/tmp}/premerge_faultmatrix"; then
+    fail "faultmatrix --quick"
+  fi
+else
+  echo "=== [3/3] faultmatrix skipped (--no-matrix) ==="
+fi
+
+if [ "$rc" = 0 ]; then
+  echo "premerge: all gates clean"
+fi
+exit "$rc"

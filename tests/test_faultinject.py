@@ -407,7 +407,7 @@ class TestFaultMatrix:
         "name",
         [
             "torn_cma_pull", "kill_allreduce_cma", "ckpt_serve_death",
-            "straggler_group",
+            "straggler_group", "perf_regression",
         ],
     )
     def test_scenario(self, tmp_path, name):
@@ -419,6 +419,13 @@ class TestFaultMatrix:
             # the fleet straggler detector hosted by this process
             res = runner.run_straggler_scenario(
                 scn, str(tmp_path / name), steps=12, timeout_s=420
+            )
+        elif name == "perf_regression":
+            # custom three-leg runner (control + mid-run onset +
+            # kill/respawn persistence) with the regression sentinel and
+            # critical-path monitors hosted by this process
+            res = runner.run_perf_regression_scenario(
+                scn, str(tmp_path / name), timeout_s=600
             )
         else:
             res = runner.run_scenario(

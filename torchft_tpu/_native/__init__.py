@@ -46,7 +46,10 @@ _TIMEOUT_CODES = (CANCELLED, DEADLINE_EXCEEDED)
 # v5: divergence sentinel (mgr.should_commit digest fields + lh.digest
 #     RPC) and crash-durable native blackbox breadcrumbs (blackbox.h) —
 #     an old build would silently drop digests, so mismatch = rebuild.
-_ABI_VERSION = 5
+# v6: fixed-retention time-series store (tsdb.h): tft_tsdb_snapshot/
+#     tft_tsdb_reset, lighthouse /timeseries.json + piggyback series
+#     ingest — an old build would silently drop every sample.
+_ABI_VERSION = 6
 
 
 def _build(force: bool = False) -> None:
@@ -200,6 +203,14 @@ def _load() -> ctypes.CDLL:
     lib.tft_lathist_snapshot.restype = c.c_int64
     lib.tft_lathist_reset.argtypes = []
     lib.tft_lathist_reset.restype = None
+
+    # time-series store (native/tsdb.h)
+    lib.tft_tsdb_snapshot.argtypes = [
+        c.POINTER(u8p), c.POINTER(c.c_int64), c.c_char_p, c.c_int,
+    ]
+    lib.tft_tsdb_snapshot.restype = c.c_int64
+    lib.tft_tsdb_reset.argtypes = []
+    lib.tft_tsdb_reset.restype = None
 
     lib.tft_quorum_compute.argtypes = [
         u8p, c.c_int64, c.POINTER(u8p), c.POINTER(c.c_int64), c.c_char_p, c.c_int,
@@ -450,6 +461,28 @@ def lathist_snapshot() -> Dict[str, Dict[str, Any]]:
 def lathist_reset() -> None:
     """Zero every native latency histogram (tests/bench interval resets)."""
     _lib.tft_lathist_reset()
+
+
+def tsdb_snapshot() -> Dict[str, Dict[str, Any]]:
+    """Snapshot this process's time-series store (the in-process
+    lighthouse's fixed-retention sample rings, ``native/tsdb.h``) as
+    ``{replica: {series: {"samples": [[epoch, step, value], ...]}}}``,
+    oldest-first per series — the test surface behind the lighthouse's
+    ``GET /timeseries.json`` range queries."""
+    outp = ctypes.POINTER(ctypes.c_uint8)()
+    outlen = ctypes.c_int64()
+    err = _errbuf()
+    code = _lib.tft_tsdb_snapshot(
+        ctypes.byref(outp), ctypes.byref(outlen), err, _ERRLEN
+    )
+    if code != OK:
+        _raise_status(code, err.value.decode())
+    return wire.decode(_take_out(outp, outlen))
+
+
+def tsdb_reset() -> None:
+    """Clear the process time-series store (tests)."""
+    _lib.tft_tsdb_reset()
 
 
 class _iovec(ctypes.Structure):

@@ -50,6 +50,8 @@ LATHIST_BOUNDS_S: Tuple[float, ...]
 
 def lathist_snapshot() -> Dict[str, Dict[str, Any]]: ...
 def lathist_reset() -> None: ...
+def tsdb_snapshot() -> Dict[str, Dict[str, Any]]: ...
+def tsdb_reset() -> None: ...
 def quorum_compute(state: Dict[str, Any]) -> Dict[str, Any]: ...
 def compute_quorum_results(
     quorum: Dict[str, Any], replica_id: str, rank: int

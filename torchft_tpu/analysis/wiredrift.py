@@ -39,9 +39,11 @@ Checks (rule ids):
 
 ``obs-env-drift``
     Same contract for the step-anatomy/SLO/straggler/forensics/
-    divergence knob families (``TORCHFT_SLO_*`` / ``TORCHFT_STRAGGLER_*``
-    / ``TORCHFT_BLACKBOX_*`` / ``TORCHFT_DIVERGENCE_*``) against the knob
-    registry in ``docs/observability.md``.
+    divergence/time-series/regression knob families (``TORCHFT_SLO_*`` /
+    ``TORCHFT_STRAGGLER_*`` / ``TORCHFT_BLACKBOX_*`` /
+    ``TORCHFT_DIVERGENCE_*`` / ``TORCHFT_TSDB_*`` /
+    ``TORCHFT_REGRESSION_*``) against the knob registry in
+    ``docs/observability.md``.
 
 ``heal-env-drift``
     Same contract for the heal-plane knob family (``TORCHFT_HEAL_*``)
@@ -279,7 +281,8 @@ def check_wire_env(
 
 
 _OBS_RE = re.compile(
-    r"TORCHFT_(?:SLO|STRAGGLER|BLACKBOX|DIVERGENCE)_[A-Z0-9_]+"
+    r"TORCHFT_(?:SLO|STRAGGLER|BLACKBOX|DIVERGENCE|TSDB|REGRESSION)"
+    r"_[A-Z0-9_]+"
 )
 
 
@@ -287,9 +290,13 @@ def check_obs_env(
     py_texts: Dict[str, str], obs_doc_text: str
 ) -> List[Finding]:
     """The TORCHFT_SLO_* / TORCHFT_STRAGGLER_* / TORCHFT_BLACKBOX_* /
-    TORCHFT_DIVERGENCE_* knob families vs the docs/observability.md
-    knob registry, both directions (the wire-env-drift contract for the
-    step-anatomy, forensics and divergence planes)."""
+    TORCHFT_DIVERGENCE_* / TORCHFT_TSDB_* / TORCHFT_REGRESSION_* knob
+    families vs the docs/observability.md knob registry, both directions
+    (the wire-env-drift contract for the step-anatomy, forensics,
+    divergence and history planes). The TSDB knobs are ALSO parsed by
+    the native store (tsdb.h getenv) — the Python references the rule
+    checks are the builder/client's shared constants, so both sides stay
+    on one registry."""
     py: Set[str] = set()
     for text in py_texts.values():
         py.update(_OBS_RE.findall(text))

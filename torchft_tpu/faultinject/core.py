@@ -53,12 +53,14 @@ Schedules are JSON (inline or ``@/path/to/file``) via
        {"site": "cma.pull", "p": 0.1, "action": "torn", "frac": 0.5}
      ]}
 
-Matching is keyed by ``(site, match, nth/every/p)``: each rule keeps its
-own hit counter; ``nth`` fires on the nth matching occurrence (once),
-``every`` on every k-th, ``p`` Bernoulli per occurrence from an RNG seeded
-by ``(seed, rule index, site, match)`` — so a fixed seed replays the
-IDENTICAL injection sequence (asserted by test). ``limit`` caps total
-fires (default 1 for ``nth``, unlimited otherwise).
+Matching is keyed by ``(site, match, nth/every/p/after)``: each rule
+keeps its own hit counter; ``nth`` fires on the nth matching occurrence
+(once), ``every`` on every k-th, ``p`` Bernoulli per occurrence from an
+RNG seeded by ``(seed, rule index, site, match)`` — so a fixed seed
+replays the IDENTICAL injection sequence (asserted by test) — and
+``after`` on EVERY occurrence from the after-th onward (a mid-run onset:
+the perf-regression scenario's level shift). ``limit`` caps total fires
+(default 1 for ``nth``, unlimited otherwise).
 
 Every fired injection emits a ``fault_injected`` telemetry event, bumps
 ``tft_faults_injected_total{site,action}``, lands in the collective flight
@@ -230,8 +232,19 @@ class _Rule:
         self.nth = spec.get("nth")
         self.every = spec.get("every")
         self.p = spec.get("p")
-        if sum(x is not None for x in (self.nth, self.every, self.p)) > 1:
-            raise ValueError("rule may set at most one of nth/every/p")
+        # onset semantics (ISSUE 11): fire on EVERY matching occurrence
+        # from the after-th onward — a mid-run level shift (the perf-
+        # regression scenario's +150ms delay) needs a clean onset step,
+        # which nth (one-shot) and every (periodic from the start)
+        # cannot express
+        self.after = spec.get("after")
+        if sum(
+            x is not None
+            for x in (self.nth, self.every, self.p, self.after)
+        ) > 1:
+            raise ValueError(
+                "rule may set at most one of nth/every/p/after"
+            )
         # nth rules are one-shot by default; every/p unlimited (limit=0)
         default_limit = 1 if self.nth is not None else 0
         self.limit = int(spec.get("limit", default_limit))
@@ -266,6 +279,8 @@ class _Rule:
             fire = self.hits % int(self.every) == 0
         elif self.p is not None:
             fire = self._rng.random() < float(self.p)
+        elif self.after is not None:
+            fire = self.hits >= int(self.after)
         else:
             fire = True
         if fire:

@@ -367,6 +367,35 @@ class Manager:
             from torchft_tpu.telemetry.slo import FleetMonitor
 
             self._fleet_monitor = FleetMonitor(self._lighthouse_addr).start()
+        # opt-in history-plane monitors (ISSUE 11): the perf-regression
+        # sentinel and the critical-path attributor both consume the
+        # lighthouse's retained time series; one knob hosts both (one
+        # history plane), rank 0 only, like the straggler monitor
+        self._regression_monitor = None
+        self._critical_path_monitor = None
+        self._cp_stop = threading.Event()
+        self._cp_thread: Optional[threading.Thread] = None
+        if (
+            os.environ.get("TORCHFT_REGRESSION_MONITOR", "0") == "1"
+            and self._lighthouse_addr is not None
+            and self._rank == 0
+        ):
+            from torchft_tpu.telemetry.critical_path import (
+                CriticalPathMonitor,
+            )
+            from torchft_tpu.telemetry.regression import RegressionMonitor
+
+            # one poll thread feeds BOTH consumers from one
+            # /timeseries.json fetch per interval — the full-ring reply
+            # can be megabytes, and two independent pollers would pay it
+            # (and the lighthouse's tsdb mutex) twice
+            self._regression_monitor = RegressionMonitor(
+                self._lighthouse_addr
+            )
+            self._critical_path_monitor = CriticalPathMonitor(
+                self._lighthouse_addr
+            )
+            self._start_history_thread()
 
         self._participating_rank: Optional[int] = None
         self._participating_world_size: int = 0
@@ -431,6 +460,33 @@ class Manager:
         self._step_digests: List[str] = []
         self._divergence_latched = False
 
+    def _start_history_thread(self) -> None:
+        """Poll loop hosting the history-plane consumers (rank 0, armed
+        by TORCHFT_REGRESSION_MONITOR=1): ONE /timeseries.json fetch per
+        TORCHFT_REGRESSION_POLL_S feeds the regression sentinel and the
+        critical-path attributor — each keeps its own per-(replica,
+        series) cursor, so sharing the reply is free."""
+        from torchft_tpu.telemetry.regression import _env_float
+        from torchft_tpu.telemetry.timeseries import poll_timeseries
+
+        poll_s = _env_float("TORCHFT_REGRESSION_POLL_S", 2.0)
+
+        def run() -> None:
+            while not self._cp_stop.wait(poll_s):
+                try:
+                    reply = poll_timeseries(self._lighthouse_addr)
+                    if not reply:
+                        continue
+                    self._regression_monitor.poll_once(reply=reply)
+                    self._critical_path_monitor.poll_once(reply=reply)
+                except Exception:  # noqa: BLE001 — monitoring must not die
+                    pass
+
+        self._cp_thread = threading.Thread(
+            target=run, daemon=True, name="tft_history_monitor"
+        )
+        self._cp_thread.start()
+
     def _on_stall(self, step: int, elapsed_s: float, threshold_s: float) -> None:
         """Watchdog stall callback (watchdog thread): ship the stuck
         report out-of-band. A wedged step sends no quorum RPCs, so the
@@ -483,28 +539,68 @@ class Manager:
         if os.environ.get("TORCHFT_TELEMETRY_PIGGYBACK", "1") == "0":
             return None
         try:
-            return {
+            # step-anatomy digest + the two detector scalars (ISSUE 8):
+            # the lighthouse stores the digest verbatim (spliced into
+            # /cluster.json like the summary) and serves the scalars to
+            # the fleet straggler detector / dashboard SLO column
+            anatomy = _json.dumps(
+                telemetry.LEDGER.summary(),
+                separators=(",", ":"),
+                default=str,
+            )
+            if len(anatomy) > (1 << 16):
+                # the lighthouse refuses (loudly) anything past its 64KiB
+                # cap; sending the oversize anyway would only burn quorum
+                # bandwidth — replace with a marker so /cluster.json
+                # shows WHY the digest is missing from both ends. Warn
+                # once per EPISODE (the flag resets when the digest
+                # shrinks back under the cap): oversize is steady-state
+                # while it lasts and this path runs at step rate, but a
+                # later unrelated episode must not be silent
+                if not getattr(self, "_anatomy_oversize_warned", False):
+                    self._anatomy_oversize_warned = True
+                    self._logger.warning(
+                        "anatomy digest %d bytes exceeds the 64KiB "
+                        "piggyback cap; sending an oversize marker "
+                        "instead (warned once per episode)",
+                        len(anatomy),
+                    )
+                anatomy = _json.dumps({"_oversized_bytes": len(anatomy)})
+            else:
+                self._anatomy_oversize_warned = False
+            payload = {
                 "summary": _json.dumps(
                     telemetry.summary(), separators=(",", ":"), default=str
                 ),
-                # step-anatomy digest + the two detector scalars (ISSUE 8):
-                # the lighthouse stores the digest verbatim (spliced into
-                # /cluster.json like the summary) and serves the scalars to
-                # the fleet straggler detector / dashboard SLO column
-                "anatomy": _json.dumps(
-                    telemetry.LEDGER.summary(),
-                    separators=(",", ":"),
-                    default=str,
-                ),
+                "anatomy": anatomy,
                 "local_step_p50_s": float(
                     telemetry.LEDGER.local_p50() or 0.0
                 ),
                 "slo_breach": bool(self._slo.breached()),
                 "step": self._step,
+                # quorum epoch keys this report's time-series samples
+                # alongside step — the same clock-sync-free coordinates
+                # every other forensic surface orders by
+                "epoch": self._quorum_id,
                 "stuck": bool(self._watchdog.stalled),
                 "last_heal_ts": float(self._last_heal_ts),
                 "spans": telemetry.TRACER.drain_chrome_fragment(),
             }
+            # per-step sample map for the lighthouse time-series store
+            # (ISSUE 11): last step row's wall/local/phase seconds,
+            # lathist quantiles and detector flags — telemetry/
+            # timeseries.py owns the vocabulary, the lighthouse stays
+            # schema-blind
+            from torchft_tpu.telemetry.timeseries import build_series
+
+            series = build_series(
+                slo_breach=bool(self._slo.breached()),
+                stuck=bool(self._watchdog.stalled),
+                divergence=bool(self._divergence_latched),
+            )
+            if series:
+                payload["series"] = series
+            return payload
         except Exception:  # noqa: BLE001 — observability must not fail quorum
             return None
 
@@ -642,6 +738,12 @@ class Manager:
         self._watchdog.stop()
         if self._fleet_monitor is not None:
             self._fleet_monitor.stop()
+        if self._regression_monitor is not None:
+            self._regression_monitor.stop()
+        self._cp_stop.set()
+        if self._cp_thread is not None:
+            self._cp_thread.join(timeout=5.0)
+            self._cp_thread = None
         # unblock any quorum thread parked on the speculation fence (its
         # heal serve will fail downstream, which is fine at shutdown)
         with self._spec_cond:

@@ -341,6 +341,23 @@ class StepLedger:
         hi = min(lo + 1, len(vs) - 1)
         return vs[lo] + (vs[hi] - vs[lo]) * (pos - lo)
 
+    def last_row(self) -> Optional[Dict[str, Any]]:
+        """The most recent step row (step / wall_s / local_s / phases) or
+        None — the per-step sample the time-series piggyback publishes
+        (telemetry/timeseries.py): percentiles smooth exactly the level
+        shifts the regression sentinel exists to catch, so the retained
+        series carries raw per-step values."""
+        with self._lock:
+            if not self._rows:
+                return None
+            r = self._rows[-1]
+            return {
+                "step": r["step"],
+                "wall_s": r["wall_s"],
+                "local_s": r["local_s"],
+                "phases": dict(r["phases"]),
+            }
+
     def local_p50(self) -> Optional[float]:
         """Rolling p50 of the local (peer-wait-excluded) step time over
         the retained row window — the scalar piggybacked to the

@@ -68,6 +68,8 @@ CANONICAL_EVENTS = (
     "straggler_cleared",
     "divergence_detected",
     "blackbox_recovered",
+    "perf_regression",
+    "perf_regression_cleared",
 )
 
 

@@ -1,0 +1,383 @@
+"""Perf-regression sentinel — Page-Hinkley level-shift detection over the
+retained time series (ISSUE 11).
+
+The fleet already has three detectors, and each has a blind spot this one
+covers:
+
+* the **SLO** evaluator needs an absolute threshold configured
+  (``TORCHFT_SLO_STEP_S``) — a fleet that drifts from 180 ms to 300 ms
+  steps under a 500 ms SLO never alarms;
+* the **straggler** detector compares replicas against each other — when
+  the WHOLE fleet slows down together (a bad rollout, a shared-storage
+  regression, thermal throttling across a rack) the leave-one-out median
+  moves with it and nothing latches;
+* the **watchdog** only fires on order-of-magnitude stalls.
+
+The sentinel is threshold-free and per-replica-per-series: for each
+``(replica, series)`` stream retained by the time-series store it runs a
+one-sided (slower-is-bad) Page-Hinkley test — the classic sequential
+level-shift statistic: ``m_t = Σ (x_i − loc_i − δ)`` with alarm when
+``m_t − min(m_t) > λ``, where ``loc`` is a running MEDIAN (robust — see
+:class:`PageHinkley`) and δ (the drift allowance) and λ (the
+cumulative-excess latch) scale RELATIVE to that location, so one
+configuration covers a 50 ms compute phase and a 2 s step wall clock
+alike. A latch emits ONE ``perf_regression`` event naming the
+shifted ``(replica, series)`` — for ``phase.*`` series that IS "which
+replica's which phase" — bumps
+``tft_perf_regression_total{replica,series}``, and clears
+(``perf_regression_cleared``) only after K consecutive samples back at
+the pre-shift baseline.
+
+Knob registry (docs/observability.md "Perf regression"; enforced both
+directions by the ``obs-env-drift`` analysis rule):
+
+====================================  =====================================
+``TORCHFT_REGRESSION_DELTA``          drift allowance δ as a fraction of
+                                      the stream's running-median
+                                      location (default 0.05)
+``TORCHFT_REGRESSION_LAMBDA``        latch threshold λ as a multiple of
+                                      the running-median location —
+                                      cumulative excess seconds beyond δ
+                                      before latching (default 3.0)
+``TORCHFT_REGRESSION_MIN_N``          samples to establish a baseline
+                                      before the statistic arms
+                                      (default 8)
+``TORCHFT_REGRESSION_K``              consecutive at-baseline samples to
+                                      clear a latch (default 5)
+``TORCHFT_REGRESSION_FLOOR_S``        absolute arming floor: the test
+                                      stays disarmed while the stream's
+                                      mean is under this many seconds —
+                                      a RELATIVE detector on a 1 ms
+                                      series latches on scheduler noise
+                                      (default 0.02)
+``TORCHFT_REGRESSION_SERIES``         comma list of series-name prefixes
+                                      to watch (default
+                                      ``local_s,phase.``; the barrier
+                                      phases — wire / quorum_wait /
+                                      commit_barrier / heal — are always
+                                      excluded unless listed by exact
+                                      name: they measure PEER waits, the
+                                      symptom, never this replica's
+                                      cause)
+``TORCHFT_REGRESSION_MONITOR``        ``1`` = the Manager (rank 0) hosts a
+                                      RegressionMonitor + CriticalPath
+                                      monitor against its lighthouse
+                                      (default 0)
+``TORCHFT_REGRESSION_POLL_S``         monitor poll interval (default 2)
+====================================  =====================================
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "PageHinkley",
+    "RegressionDetector",
+    "RegressionMonitor",
+]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class PageHinkley:
+    """One-sided Page-Hinkley test for an UPWARD level shift (durations:
+    up = slower = bad), with relative δ/λ, a ROBUST location estimate
+    and latch/clear hysteresis.
+
+    Two robustness choices, both learned from real traces:
+
+    * the location estimate is a running **median** over a bounded
+      window, not a mean — the first jax steps of a real trainer are
+      30–40× the steady state (compile), and a mean poisoned by two
+      warm-up samples sits above the shifted level for the whole run
+      (observed: steady 0.09 s, warm-up 4.0 s, +0.15 s shift never
+      latched against the 0.5 s running mean);
+    * positive deviations are **winsorized** at 2× the location — one
+      10× spike (a re-jit, a GC pause) must contribute a bounded step to
+      the statistic, not an instant latch; a real level shift persists
+      and accumulates past λ anyway.
+
+    States: warming (n < min_n, or location under the floor) → armed →
+    latched. While latched the pre-shift baseline is frozen (an adapting
+    location would chase the shift and declare the new level normal);
+    K consecutive samples back under ``baseline × (1 + δ)`` clear the
+    latch and re-arm fresh."""
+
+    WINDOW = 256  # samples kept for the running median
+    CLIP = 2.0    # positive-deviation winsor, multiples of the location
+
+    def __init__(
+        self,
+        delta: Optional[float] = None,
+        lam: Optional[float] = None,
+        min_n: Optional[int] = None,
+        k: Optional[int] = None,
+        floor: Optional[float] = None,
+    ) -> None:
+        self.delta = delta if delta is not None else _env_float(
+            "TORCHFT_REGRESSION_DELTA", 0.05
+        )
+        self.lam = lam if lam is not None else _env_float(
+            "TORCHFT_REGRESSION_LAMBDA", 3.0
+        )
+        self.min_n = int(min_n if min_n is not None else _env_int(
+            "TORCHFT_REGRESSION_MIN_N", 8
+        ))
+        self.k = int(k if k is not None else _env_int(
+            "TORCHFT_REGRESSION_K", 5
+        ))
+        self.floor = floor if floor is not None else _env_float(
+            "TORCHFT_REGRESSION_FLOOR_S", 0.02
+        )
+        from collections import deque
+
+        self._window: Any = deque(maxlen=self.WINDOW)
+        self.n = 0
+        self.location = 0.0  # running median of the window
+        self._mh = 0.0
+        self._mh_min = 0.0
+        self.latched = False
+        self.latches = 0
+        self.baseline = 0.0  # frozen pre-shift location while latched
+        self._under = 0
+
+    def observe(self, x: float) -> Optional[str]:
+        """Feed one sample; returns ``"latched"`` / ``"cleared"`` on a
+        transition, else None."""
+        from statistics import median
+
+        if self.latched:
+            # frozen baseline: recovery means returning to where the
+            # stream WAS, not to wherever the shift dragged the location
+            if x <= self.baseline * (1.0 + self.delta):
+                self._under += 1
+                if self._under >= self.k:
+                    self.latched = False
+                    self._under = 0
+                    # re-arm fresh: the episode is over
+                    self._window.clear()
+                    self._window.append(x)
+                    self.n = 1
+                    self.location = x
+                    self._mh = 0.0
+                    self._mh_min = 0.0
+                    return "cleared"
+            else:
+                self._under = 0
+            return None
+        self.n += 1
+        self._window.append(x)
+        self.location = median(self._window)
+        if self.n < self.min_n:
+            return None  # baseline warm-up: nothing to deviate from yet
+        scale = abs(self.location)
+        if scale < self.floor:
+            # a relative test on a microsecond-scale stream measures
+            # scheduler noise, not performance — stay disarmed (found the
+            # hard way: the 1 ms commit_barrier phase false-latched the
+            # control soak before this floor existed)
+            self._mh = 0.0
+            self._mh_min = 0.0
+            return None
+        dev = x - self.location - self.delta * scale
+        if dev > self.CLIP * scale:
+            dev = self.CLIP * scale  # winsorize: one spike, bounded step
+        self._mh += dev
+        self._mh_min = min(self._mh_min, self._mh)
+        if (self._mh - self._mh_min) > self.lam * scale:
+            self.latched = True
+            self.latches += 1
+            self.baseline = self.location
+            self._under = 0
+            return "latched"
+        return None
+
+
+def _watched_prefixes() -> Tuple[str, ...]:
+    raw = os.environ.get("TORCHFT_REGRESSION_SERIES", "local_s,phase.")
+    return tuple(p for p in (s.strip() for s in raw.split(",")) if p)
+
+
+# Peer-wait phases are the SYMPTOM side of a slowdown (a slow peer
+# inflates everyone else's barriers) — watching them would blame victims.
+# Same reasoning as critical_path's non-barrier blame split; excluded
+# from the watch unless a deployment lists one by exact name.
+def _barrier_series() -> Tuple[str, ...]:
+    from torchft_tpu.telemetry.anatomy import BARRIER_PHASES
+
+    return tuple(f"phase.{p}" for p in BARRIER_PHASES)
+
+
+class RegressionDetector:
+    """Per-(replica, series) Page-Hinkley bank over the watched series
+    prefixes. Feed with :meth:`observe`; emits ``perf_regression`` /
+    ``perf_regression_cleared`` events and bumps
+    ``tft_perf_regression_total{replica,series}`` on transitions."""
+
+    def __init__(
+        self,
+        prefixes: Optional[Tuple[str, ...]] = None,
+        **ph_kwargs: Any,
+    ) -> None:
+        self._ph_kwargs = ph_kwargs
+        self.prefixes = (
+            tuple(prefixes) if prefixes is not None else _watched_prefixes()
+        )
+        self._tests: Dict[Tuple[str, str], PageHinkley] = {}
+
+    def watched(self, series: str) -> bool:
+        if series in _barrier_series() and series not in self.prefixes:
+            return False
+        return any(series.startswith(p) for p in self.prefixes)
+
+    def regressed(self) -> List[Tuple[str, str]]:
+        """Currently latched (replica, series) pairs, sorted."""
+        return sorted(
+            key for key, ph in self._tests.items() if ph.latched
+        )
+
+    def observe(
+        self, replica: str, series: str, step: int, value: float
+    ) -> Optional[Dict[str, Any]]:
+        """One sample; returns the emitted event record on a latch/clear
+        transition, else None."""
+        if not self.watched(series):
+            return None
+        key = (replica, series)
+        ph = self._tests.get(key)
+        if ph is None:
+            ph = self._tests[key] = PageHinkley(**self._ph_kwargs)
+        transition = ph.observe(value)
+        if transition is None:
+            return None
+        # phase.<name> series name the anatomy phase directly; the rest
+        # (local_s, wall_s, lat.*) name themselves
+        phase = (
+            series[len("phase."):] if series.startswith("phase.") else series
+        )
+        if transition == "latched":
+            ev = {
+                "event": "perf_regression",
+                "replica": replica,
+                "series": series,
+                "phase": phase,
+                "step": step,
+                "baseline_s": round(ph.baseline, 6),
+                "value_s": round(value, 6),
+            }
+            try:
+                from torchft_tpu import telemetry
+
+                telemetry.PERF_REGRESSION_TOTAL.labels(
+                    replica=replica, series=series
+                ).inc()
+                telemetry.emit(
+                    "perf_regression",
+                    **{k: v for k, v in ev.items() if k != "event"},
+                )
+            except Exception:  # noqa: BLE001 — never fail the monitor
+                pass
+            return ev
+        ev = {
+            "event": "perf_regression_cleared",
+            "replica": replica,
+            "series": series,
+            "phase": phase,
+            "step": step,
+            "value_s": round(value, 6),
+        }
+        try:
+            from torchft_tpu import telemetry
+
+            telemetry.emit(
+                "perf_regression_cleared",
+                **{k: v for k, v in ev.items() if k != "event"},
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        return ev
+
+
+class RegressionMonitor:
+    """Fleet-side host: polls the lighthouse's ``/timeseries.json`` and
+    feeds every new sample of the watched series to a
+    :class:`RegressionDetector`, in step order per stream. Run one per
+    fleet (the faultmatrix runner hosts one; a Manager hosts one under
+    ``TORCHFT_REGRESSION_MONITOR=1``)."""
+
+    def __init__(
+        self,
+        lighthouse_addr: str,
+        detector: Optional[RegressionDetector] = None,
+        poll_s: Optional[float] = None,
+    ) -> None:
+        self.addr = lighthouse_addr
+        self.detector = detector or RegressionDetector()
+        self.poll_s = poll_s if poll_s is not None else _env_float(
+            "TORCHFT_REGRESSION_POLL_S", 2.0
+        )
+        self._cursor: Dict[Tuple[str, str], int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(
+        self, reply: Optional[Dict[str, Any]] = None
+    ) -> List[Dict[str, Any]]:
+        """One poll + detection round; returns the transition events
+        emitted (also the testable core). Pass ``reply`` to reuse a
+        /timeseries.json fetch another consumer already paid for (the
+        Manager's history thread feeds this monitor and the critical-path
+        monitor from ONE poll — the full-ring reply can be megabytes)."""
+        from torchft_tpu.telemetry.timeseries import (
+            iter_new_samples,
+            poll_timeseries,
+        )
+
+        if reply is None:
+            reply = poll_timeseries(self.addr)
+        if not reply:
+            return []
+        events: List[Dict[str, Any]] = []
+        for rid, name, _epoch, step, value in iter_new_samples(
+            reply, self._cursor
+        ):
+            ev = self.detector.observe(rid, name, step, value)
+            if ev is not None:
+                events.append(ev)
+        return events
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — monitoring must not die
+                pass
+
+    def start(self) -> "RegressionMonitor":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="tft_regression_monitor"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_s + 2.0)
+            self._thread = None
