@@ -575,6 +575,24 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         extra["commit_pipeline"] = {"error": str(e)}
 
+    # Always-on profiler overhead (ISSUE 12): the SAME headline leg
+    # armed at default Hz vs disarmed, interleaved medians — acceptance
+    # gate <=2%. Own process so the A/B toggling (and its samples) never
+    # contaminate this process's ledger/lathist rows.
+    try:
+        extra.update(
+            _run_json_subprocess(
+                [
+                    sys.executable, "-m",
+                    "torchft_tpu.benchmarks.profiler_overhead",
+                ],
+                timeout_s=1200,
+                env_extra={"JAX_PLATFORMS": "cpu"},
+            )
+        )
+    except Exception as e:  # noqa: BLE001
+        extra["profiler_overhead"] = {"error": str(e)}
+
     # REAL on-chip 2-group averaging: two processes time-sharing the chip
     # over the host plane (round-4 review weak #8). See the module
     # docstring for the two box constraints this row records.

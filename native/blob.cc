@@ -13,6 +13,7 @@
 #include <stdexcept>
 
 #include "faultinject.h"  // env-gated injection (torn serve, serve kill)
+#include "profiler.h"     // always-on sampling (blob serve thread stacks)
 #include "rpc.h"          // tcp_listen / tcp_connect / listen_port / now_ms
 #include "stripe.h"       // shared stripe framing/socket plumbing
 
@@ -114,6 +115,7 @@ void BlobServer::accept_loop() {
 }
 
 void BlobServer::serve_conn(int fd, uint64_t id) {
+  prof::ThreadGuard prof_guard("blob.serve");
   for (;;) {
     BlobReq req{};
     bool timed_out = false;

@@ -14,6 +14,7 @@
 
 #include "coord.h"
 #include "lathist.h"
+#include "profiler.h"
 #include "rpc.h"
 #include "tsdb.h"
 #include "wire.h"
@@ -356,6 +357,40 @@ int64_t tft_tsdb_snapshot(uint8_t** out, int64_t* outlen, char* err,
 }
 
 void tft_tsdb_reset() { tsdb::store().reset(); }
+
+// ---- always-on sampling profiler (profiler.h) ----
+
+// Retarget the sampling rate live (the diagnosis engine's burst window);
+// 0 pauses sampling, >0 arms it (installing the SIGPROF handler and the
+// sampler thread on first use).
+void tft_prof_set_hz(double hz) { prof::set_hz(hz); }
+
+// Effective rate: the env default is resolved lazily at first thread
+// registration, so this also forces that resolution (the overhead-smoke
+// legs read it to prove which mode they measured).
+double tft_prof_hz() {
+  prof::maybe_arm();
+  return prof::current_hz();
+}
+
+// Flamegraph-ready collapsed stacks of every sample drained so far:
+// "label;root;...;leaf count\n" per unique stack, sorted. Cumulative —
+// the caller diffs two snapshots (telemetry.profiler.subtract_folded)
+// for a bounded capture window.
+int64_t tft_prof_snapshot(uint8_t** out, int64_t* outlen, char* err,
+                          int errlen) {
+  try {
+    *out = alloc_out(prof::snapshot_folded(), outlen);
+    return OK;
+  } catch (const std::exception& e) {
+    set_err(err, errlen, e.what());
+    return INTERNAL;
+  }
+}
+
+int64_t tft_prof_samples_total() { return (int64_t)prof::samples_total(); }
+
+void tft_prof_reset() { prof::reset(); }
 
 // quorum_buf encodes a Quorum value. Response: ManagerQuorumResult map.
 int64_t tft_compute_quorum_results(const uint8_t* quorum_buf, int64_t len,

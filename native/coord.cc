@@ -581,6 +581,23 @@ void Lighthouse::ingest_telemetry(const std::string& replica_id,
       t.anatomy_json = std::move(anatomy);
     }
   }
+  // diagnosis-bundle availability (ISSUE 12): counts + names only — the
+  // bundles themselves stay on the replica's disk; size caps keep a
+  // malformed reporter from growing the coordinator's store
+  if (v.has("diag_bundles"))
+    t.diag_bundles = v.geti("diag_bundles", t.diag_bundles);
+  // cap overflow replaces the stored value with a loud marker instead
+  // of silently keeping the STALE predecessor: /diagnosis.json and the
+  // dashboard would otherwise point an operator at the previous
+  // incarnation's evidence path as if it were current
+  std::string diag_last = v.gets("diag_last");
+  if (!diag_last.empty())
+    t.diag_last = diag_last.size() <= 256 ? std::move(diag_last)
+                                          : std::string("(oversized)");
+  std::string diag_dir = v.gets("diag_dir");
+  if (!diag_dir.empty())
+    t.diag_dir = diag_dir.size() <= 512 ? std::move(diag_dir)
+                                        : std::string("(oversized)");
   // time-series ingest (ISSUE 11): an opaque {series-name: double} map
   // sampled at the report's (epoch, step) coordinates. The lighthouse
   // stays schema-blind — names mean whatever the Python side says.
@@ -843,7 +860,7 @@ std::string Lighthouse::status_html() {
     o << "<h2>Replica health</h2><table border=1 cellpadding=4>"
          "<tr><th>replica_id</th><th>last report</th><th>step</th>"
          "<th>last heal</th><th>local p50</th><th>trend</th><th>stuck</th>"
-         "<th>SLO</th><th>digest</th></tr>";
+         "<th>SLO</th><th>digest</th><th>diag</th></tr>";
     // two clocks on purpose: report ages use the monotonic clock that
     // stamped last_ms (mixing in wall time would show epoch-offset
     // garbage), while last_heal_ts is a unix timestamp from the replica
@@ -875,9 +892,19 @@ std::string Lighthouse::status_html() {
         << "</td><td"
         << (diverged_replicas_.count(id) ? " style=\"background:red\"" : "")
         << ">" << (diverged_replicas_.count(id) ? "DIVERGED" : "ok")
-        << "</td></tr>";
+        // diagnosis-bundle column (ISSUE 12): bundle count + the latest
+        // bundle's name, linked to the fleet index so an operator lands
+        // on the evidence one click after the red latch column
+        << "</td><td>";
+    if (t.diag_bundles > 0)
+      o << "<a href=\"/diagnosis.json\">" << t.diag_bundles << " ("
+        << html_escape(t.diag_last) << ")</a>";
+    else
+      o << "-";
+    o << "</td></tr>";
     }
     o << "</table><p><a href=\"/cluster.json\">cluster.json</a> | "
+         "<a href=\"/diagnosis.json\">diagnosis.json</a> | "
          "<a href=\"/trace\">merged trace (open in Perfetto)</a></p>";
   }
   o << "<h2>FT events</h2><p>evictions: " << evictions_total_
@@ -947,6 +974,34 @@ std::string Lighthouse::cluster_json() {
   return o.str();
 }
 
+std::string Lighthouse::diagnosis_json() {
+  // Fleet index of latch-triggered diagnosis bundles (ISSUE 12): which
+  // replica captured evidence, how much, and where it lives. The status
+  // hint is explicit — "empty" (fleet wired, nothing captured: the
+  // healthy answer) vs a populated "ok" — so a scraper never has to
+  // guess what a bare empty map means (the ambiguity that bit the
+  // PR 11 /critical_path.json bring-up).
+  std::unique_lock<std::mutex> lk(mu_);
+  int64_t total = 0;
+  for (const auto& [id, t] : telemetry_) {
+    (void)id;
+    total += t.diag_bundles;
+  }
+  std::ostringstream o;
+  o << "{\"status\":\"" << (total > 0 ? "ok" : "empty")
+    << "\",\"bundles_total\":" << total << ",\"replicas\":{";
+  bool first = true;
+  for (const auto& [id, t] : telemetry_) {
+    if (!first) o << ",";
+    first = false;
+    o << "\"" << json_escape(id) << "\":{\"bundles\":" << t.diag_bundles
+      << ",\"last\":\"" << json_escape(t.diag_last) << "\",\"dir\":\""
+      << json_escape(t.diag_dir) << "\",\"step\":" << t.step << "}";
+  }
+  o << "}}";
+  return o.str();
+}
+
 std::string Lighthouse::merged_trace_json() {
   // Chrome trace-event JSON merging every replica's piggybacked span
   // batches onto one timeline. Batches are comma-joined fragments of
@@ -983,6 +1038,8 @@ std::string Lighthouse::handle_http(const std::string& method,
   if (method == "GET" && path == "/status") return http_ok(status_html());
   if (method == "GET" && path == "/cluster.json")
     return http_ok(cluster_json(), "application/json");
+  if (method == "GET" && path == "/diagnosis.json")
+    return http_ok(diagnosis_json(), "application/json");
   // Range queries over the retained time series (ISSUE 11). Query
   // params: replica=<substr> series=<substr> since=<step, exclusive>
   // max_points=<downsample cap per series>. The `cursor.max_step` in

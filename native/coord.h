@@ -147,6 +147,15 @@ struct ReplicaTelemetry {
   // JSON object would parse as garbage downstream) and this counter
   // makes the drop loud on /cluster.json + /metrics (ISSUE 11).
   int64_t anatomy_oversized = 0;
+  // Diagnosis-bundle availability (ISSUE 12): replicas announce how many
+  // latch-triggered deep-capture bundles they have written under their
+  // TORCHFT_DIAG_DIR, plus the most recent bundle's name and the
+  // replica-local directory — served at GET /diagnosis.json so an
+  // operator (or the postmortem tool) knows where the evidence lives
+  // without asking every host.
+  int64_t diag_bundles = 0;
+  std::string diag_last;  // most recent bundle name (size-capped)
+  std::string diag_dir;   // replica-local bundle directory (size-capped)
   std::vector<std::string> span_batches;  // chrome trace-event fragments
   size_t span_bytes = 0;    // bytes across span_batches (for the cap)
 };
@@ -198,6 +207,7 @@ class Lighthouse {
   void ingest_telemetry(const std::string& replica_id, const Value& v);
   std::string status_html();
   std::string cluster_json();
+  std::string diagnosis_json();
   std::string merged_trace_json();
   static std::string http_error_page(const std::string& msg);
 

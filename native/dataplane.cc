@@ -21,6 +21,7 @@
 #include "blackbox.h"     // crash-durable dp.hop / dp.stripe breadcrumbs
 #include "faultinject.h"  // env-gated injection points (torn hops, kills)
 #include "lathist.h"      // dp.hop / dp.stripe latency histograms
+#include "profiler.h"     // always-on sampling (dp pump thread stacks)
 #include "rpc.h"  // tcp_listen / tcp_connect / listen_port / now_ms
 #include "stripe.h"  // shared stripe framing/partition (also used by blob.cc)
 
@@ -847,6 +848,10 @@ int DataPlane::run_stripe(int stripe_idx, Job& job, int* bad_peer,
 }
 
 void DataPlane::worker_loop(int stripe_idx) {
+  // samples name this thread "dp.pump" in the collapsed stacks; the
+  // per-hop path itself gains zero instructions (registration happens
+  // once, here — see profiler.h)
+  prof::ThreadGuard prof_guard("dp.pump");
   auto& st = *stripes_[stripe_idx];
   for (;;) {
     Job job;
@@ -983,7 +988,12 @@ extern "C" {
 // build and rebuilds in place.
 // v5: mgr.should_commit carries divergence-sentinel digests, lh.digest
 // RPC added, native blackbox breadcrumbs (blackbox.h) compiled in.
-int tft_abi_version() { return 6; }
+// v6: fixed-retention time-series store (tsdb.h): tft_tsdb_snapshot/
+// tft_tsdb_reset + lighthouse /timeseries.json ingest.
+// v7: always-on sampling profiler (profiler.h): tft_prof_set_hz/hz/
+// snapshot/reset/samples_total — a stale build would fail the loader's
+// symbol lookup at import.
+int tft_abi_version() { return 7; }
 
 int64_t tft_dp_create(int rank, int world, int nstripes, char* err,
                       int errlen) {

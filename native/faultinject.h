@@ -93,7 +93,12 @@ inline MethodSpec parse_method(const char* env) {
   return s;
 }
 
-inline void sleep_ms(long ms) {
+// noinline on purpose: the sampling profiler (profiler.h) must be able
+// to name this frame in a victim's collapsed stacks — the
+// diagnose_straggler scenario asserts the injected delay dominates the
+// victim's hot stack, which needs `fi::sleep_ms` to survive as a symbol
+// instead of folding into the hop loop.
+__attribute__((noinline)) inline void sleep_ms(long ms) {
   std::this_thread::sleep_for(std::chrono::milliseconds(ms));
 }
 

@@ -1,0 +1,452 @@
+// torchft_tpu native core — always-on sampling profiler for the GIL-free
+// planes (ISSUE 12).
+//
+// The Python-side telemetry can sample interpreter threads with
+// sys._current_frames, but the hot native threads — the dp stripe pumps,
+// the rpc serve loop, the blob range servers — never touch the
+// interpreter, so until now "which code inside the slow phase" was
+// unanswerable for exactly the threads that carry the bytes. This header
+// is the Google-Wide-Profiler-shaped answer:
+//
+//   * threads REGISTER themselves once at entry (ThreadGuard — a handful
+//     of stores; the per-hop hot path gains literally zero instructions);
+//   * a single sampler thread ticks at TORCHFT_PROF_HZ (default
+//     kDefaultHz, 0 = disarmed: no handler installed, no sampler thread,
+//     no signals — zero cost) and tgkill()s each registered thread with
+//     SIGPROF;
+//   * the signal handler backtrace()s into a lock-free per-thread ring
+//     (per-slot seqlock, every field an atomic — TSan-clean by
+//     construction, async-signal-safe: backtrace is preloaded at arm
+//     time so its lazy libgcc dlopen never runs in a handler);
+//   * the sampler drains rings into a process-wide collapsed-stack
+//     aggregate, rendered on demand as flamegraph-ready .folded text
+//     ("label;root;...;leaf count") with dladdr+demangle symbolization;
+//   * tft_prof_set_hz() retargets the rate live — the diagnosis engine
+//     (telemetry/diagnosis.py) boosts to TORCHFT_PROF_BURST_HZ for a
+//     bounded capture window, then restores.
+//
+// Signal-safety contract with the transport planes: every registered
+// thread runs nonblocking sockets with EINTR-tolerant poll loops
+// (stripe.h ignores poll's rc and re-checks the deadline; rpc.cc/blob.cc
+// `continue` on EINTR), and the handler is installed SA_RESTART for the
+// blocking-socket paths — a sample can delay a hop by microseconds but
+// never fail it.
+#ifndef TFT_PROFILER_H_
+#define TFT_PROFILER_H_
+
+#include <cxxabi.h>
+#include <dlfcn.h>
+#include <execinfo.h>
+#include <pthread.h>
+#include <signal.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+
+namespace tft {
+namespace prof {
+
+constexpr int kMaxFrames = 24;
+// backtrace()'s top frames are the handler itself + the kernel signal
+// trampoline; the interrupted code starts below them
+constexpr int kSkipFrames = 2;
+constexpr int kMaxThreads = 64;
+constexpr int kRing = 128;  // samples buffered per thread between drains
+constexpr double kDefaultHz = 11.0;  // prime-ish: avoids lockstep with
+                                     // 10ms schedulers and 100Hz ticks
+
+struct Slot {
+  // seqlock: even = stable, odd = handler writing. All payload fields
+  // are relaxed atomics so a torn concurrent read is impossible (and
+  // TSan sees no data race); seq validates consistency.
+  std::atomic<uint32_t> seq{0};
+  std::atomic<int> n{0};
+  std::atomic<void*> pc[kMaxFrames];
+};
+
+struct ThreadRec {
+  // 0 = free, 1 = claiming, 2 = active. Retired slots return to 0 after
+  // the owner drains its own ring (unregister_thread), so churning
+  // connection threads recycle the fixed table.
+  std::atomic<int> state{0};
+  std::atomic<long> tid{0};  // kernel tid (tgkill target; safe vs exit)
+  char label[24] = {0};
+  std::atomic<uint64_t> head{0};  // samples ever written by the handler
+  uint64_t drained = 0;           // guarded-by: State::agg_mu
+  Slot ring[kRing];
+};
+
+struct State {
+  ThreadRec threads[kMaxThreads];
+  std::atomic<double> hz{-1.0};      // -1 = env not parsed yet
+  std::atomic<long> sampler_pid{0};  // pid owning the live sampler thread
+  std::atomic<uint64_t> samples{0};  // drained into the aggregate
+  std::atomic<uint64_t> dropped{0};  // ring overruns between drains
+  std::atomic<uint64_t> table_full{0};  // threads that ran unprofiled
+  std::mutex agg_mu;  // aggregate + every ring's drained cursor
+  // collapsed-stack aggregate: key = label '\0' raw leaf-first pc array
+  std::map<std::string, uint64_t> agg;
+  std::mutex arm_mu;  // handler install + sampler start + hz writes
+  bool handler_installed = false;
+  bool atfork_installed = false;
+};
+
+inline State& S() {
+  static State s;
+  return s;
+}
+
+// The handler finds its own record by tid scan instead of a
+// thread_local pointer: this library is dlopen'd, so a thread_local
+// here would live in dynamic TLS — whose deallocation at thread reap
+// TSan cannot pair with the thread's own last write (a hard false
+// positive) — and a 64-entry atomic scan is both async-signal-safe and
+// cheaper than it sounds (one pass per sample, not per hop).
+inline ThreadRec* find_self() {
+  long tid = (long)syscall(SYS_gettid);
+  State& st = S();
+  for (int i = 0; i < kMaxThreads; ++i) {
+    ThreadRec& r = st.threads[i];
+    if (r.state.load(std::memory_order_acquire) == 2 &&
+        r.tid.load(std::memory_order_acquire) == tid)
+      return &r;
+  }
+  return nullptr;
+}
+
+// ---- signal handler (async-signal-safe: backtrace preloaded, atomics
+// only) ---------------------------------------------------------------------
+
+inline void sig_handler(int, siginfo_t*, void*) {
+  int saved_errno = errno;
+  ThreadRec* r = find_self();
+  if (!r) {
+    errno = saved_errno;
+    return;  // unregistered thread (tid recycling race): ignore
+  }
+  void* buf[kMaxFrames + kSkipFrames];
+  int n = ::backtrace(buf, kMaxFrames + kSkipFrames);
+  int keep = n - kSkipFrames;
+  if (keep < 0) keep = 0;
+  if (keep > kMaxFrames) keep = kMaxFrames;
+  uint64_t h = r->head.load(std::memory_order_relaxed);
+  Slot& s = r->ring[h % kRing];
+  uint32_t q = s.seq.load(std::memory_order_relaxed);
+  // standard seqlock writer: the odd store must be ordered BEFORE the
+  // payload stores (a release store only orders what precedes it), so
+  // the barrier between them is an explicit release fence — without it
+  // a weakly-ordered CPU could publish new frames under an old even
+  // seq and a concurrent drain would validate a mixed-generation stack
+  s.seq.store(q + 1, std::memory_order_relaxed);  // odd: writing
+  std::atomic_thread_fence(std::memory_order_release);
+  for (int i = 0; i < keep; ++i)
+    s.pc[i].store(buf[i + kSkipFrames], std::memory_order_relaxed);
+  s.n.store(keep, std::memory_order_relaxed);
+  s.seq.store(q + 2, std::memory_order_release);  // even: stable
+  r->head.store(h + 1, std::memory_order_release);
+  errno = saved_errno;
+}
+
+// ---- drain (under State::agg_mu) ------------------------------------------
+
+inline void drain_ring_locked(ThreadRec& r) {
+  State& st = S();
+  uint64_t head = r.head.load(std::memory_order_acquire);
+  if (head > r.drained + kRing) {
+    st.dropped.fetch_add(head - r.drained - kRing,
+                         std::memory_order_relaxed);
+    r.drained = head - kRing;
+  }
+  for (uint64_t i = r.drained; i < head; ++i) {
+    Slot& s = r.ring[i % kRing];
+    uint32_t q1 = s.seq.load(std::memory_order_acquire);
+    if (q1 & 1) continue;  // handler mid-write (wrap race): skip
+    void* pcs[kMaxFrames];
+    int n = s.n.load(std::memory_order_relaxed);
+    if (n < 0 || n > kMaxFrames) continue;
+    for (int j = 0; j < n; ++j)
+      pcs[j] = s.pc[j].load(std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (s.seq.load(std::memory_order_relaxed) != q1) continue;  // torn
+    std::string key(r.label);
+    key.push_back('\0');
+    key.append(reinterpret_cast<const char*>(pcs),
+               (size_t)n * sizeof(void*));
+    st.agg[key]++;
+    st.samples.fetch_add(1, std::memory_order_relaxed);
+  }
+  r.drained = head;
+}
+
+inline void drain_all_locked() {
+  State& st = S();
+  for (int i = 0; i < kMaxThreads; ++i) {
+    ThreadRec& r = st.threads[i];
+    if (r.state.load(std::memory_order_acquire) == 2) drain_ring_locked(r);
+  }
+}
+
+// ---- sampler ---------------------------------------------------------------
+
+inline void sampler_loop() {
+  State& st = S();
+  const long pid = (long)getpid();
+  for (;;) {
+    if (st.sampler_pid.load(std::memory_order_acquire) != pid)
+      return;  // superseded (fork) — the owning pid runs its own loop
+    double hz = st.hz.load(std::memory_order_acquire);
+    if (hz <= 0) {
+      // paused (set_hz(0)): stay alive so a later boost resumes instantly
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      continue;
+    }
+    for (int i = 0; i < kMaxThreads; ++i) {
+      ThreadRec& r = st.threads[i];
+      if (r.state.load(std::memory_order_acquire) != 2) continue;
+      long tid = r.tid.load(std::memory_order_acquire);
+      if (tid > 0) syscall(SYS_tgkill, pid, tid, SIGPROF);
+    }
+    double period = 1.0 / hz;
+    if (period < 0.001) period = 0.001;  // 1 kHz ceiling
+    std::this_thread::sleep_for(std::chrono::duration<double>(period));
+    {
+      std::lock_guard<std::mutex> g(st.agg_mu);
+      drain_all_locked();
+    }
+  }
+}
+
+inline double env_hz() {
+  const char* v = std::getenv("TORCHFT_PROF_HZ");
+  if (!v || !*v) return kDefaultHz;
+  return std::atof(v);
+}
+
+// fork safety: the sampler thread does not survive fork, and agg_mu must
+// not be held across it (a child forked mid-drain would deadlock on its
+// first snapshot). Registered once, at first arm.
+inline void atfork_prepare() {
+  S().arm_mu.lock();
+  S().agg_mu.lock();
+}
+inline void atfork_release() {
+  S().agg_mu.unlock();
+  S().arm_mu.unlock();
+}
+
+inline void ensure_running() {
+  State& st = S();
+  if (st.hz.load(std::memory_order_acquire) <= 0) return;  // disarmed
+  const long pid = (long)getpid();
+  if (st.sampler_pid.load(std::memory_order_acquire) == pid) return;
+  std::lock_guard<std::mutex> g(st.arm_mu);
+  if (st.sampler_pid.load(std::memory_order_relaxed) == pid) return;
+  if (!st.atfork_installed) {
+    pthread_atfork(atfork_prepare, atfork_release, atfork_release);
+    st.atfork_installed = true;
+  }
+  if (!st.handler_installed) {
+    // preload backtrace's lazy libgcc_s dlopen OUTSIDE signal context
+    void* warm[2];
+    ::backtrace(warm, 2);
+    struct sigaction sa;
+    std::memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = sig_handler;
+    sa.sa_flags = SA_RESTART | SA_SIGINFO;
+    sigemptyset(&sa.sa_mask);
+    sigaction(SIGPROF, &sa, nullptr);
+    st.handler_installed = true;
+  }
+  // ownership flag BEFORE the spawn: the loop's first act is to check
+  // it, and a fresh thread can win that race against a late store
+  st.sampler_pid.store(pid, std::memory_order_release);
+  std::thread(sampler_loop).detach();
+}
+
+inline void maybe_arm() {
+  State& st = S();
+  if (st.hz.load(std::memory_order_acquire) < 0) {
+    std::lock_guard<std::mutex> g(st.arm_mu);
+    if (st.hz.load(std::memory_order_relaxed) < 0)
+      st.hz.store(env_hz(), std::memory_order_release);
+  }
+  ensure_running();
+}
+
+inline double current_hz() {
+  double hz = S().hz.load(std::memory_order_acquire);
+  return hz < 0 ? 0.0 : hz;
+}
+
+inline void set_hz(double hz) {
+  S().hz.store(hz, std::memory_order_release);
+  if (hz > 0) ensure_running();
+}
+
+// ---- thread registration ---------------------------------------------------
+
+inline ThreadRec* register_thread(const char* label) {
+  maybe_arm();
+  State& st = S();
+  for (int i = 0; i < kMaxThreads; ++i) {
+    ThreadRec& r = st.threads[i];
+    int expect = 0;
+    if (!r.state.compare_exchange_strong(expect, 1,
+                                         std::memory_order_acq_rel))
+      continue;
+    std::snprintf(r.label, sizeof(r.label), "%s", label);
+    // scrub the previous tenant's ring so stale seq parity / samples
+    // can't leak into this thread's stacks
+    for (int j = 0; j < kRing; ++j) {
+      r.ring[j].seq.store(0, std::memory_order_relaxed);
+      r.ring[j].n.store(0, std::memory_order_relaxed);
+    }
+    r.head.store(0, std::memory_order_relaxed);
+    {
+      std::lock_guard<std::mutex> g(st.agg_mu);
+      r.drained = 0;
+    }
+    r.tid.store((long)syscall(SYS_gettid), std::memory_order_release);
+    r.state.store(2, std::memory_order_release);
+    return &r;
+  }
+  // table full: this thread runs unprofiled — counted, and surfaced as
+  // a synthetic line in every snapshot (caps must be LOUD: a flamegraph
+  // with silently-partial coverage reads as "that plane isn't hot")
+  st.table_full.fetch_add(1, std::memory_order_relaxed);
+  return nullptr;
+}
+
+inline void unregister_thread(ThreadRec* r) {
+  if (!r) return;
+  // an in-flight SIGPROF to this thread stops matching once the tid
+  // clears (a handler interrupting THIS function sees either the old
+  // tid — sample lands in the ring we are about to drain — or no match)
+  r->tid.store(0, std::memory_order_release);
+  State& st = S();
+  {
+    // the owner drains its own tail so no samples are lost and the slot
+    // can be recycled immediately (the sampler's drains serialize on the
+    // same mutex)
+    std::lock_guard<std::mutex> g(st.agg_mu);
+    drain_ring_locked(*r);
+  }
+  r->state.store(0, std::memory_order_release);
+}
+
+struct ThreadGuard {
+  explicit ThreadGuard(const char* label)
+      : rec_(register_thread(label)) {}
+  ~ThreadGuard() { unregister_thread(rec_); }
+  ThreadGuard(const ThreadGuard&) = delete;
+  ThreadGuard& operator=(const ThreadGuard&) = delete;
+
+ private:
+  ThreadRec* rec_;
+};
+
+// ---- snapshot / render -----------------------------------------------------
+
+inline std::string symbolize(void* pc) {
+  static std::mutex mu;
+  static std::map<void*, std::string> cache;
+  std::lock_guard<std::mutex> g(mu);
+  auto it = cache.find(pc);
+  if (it != cache.end()) return it->second;
+  std::string name;
+  Dl_info info;
+  if (dladdr(pc, &info) && info.dli_sname) {
+    int status = 0;
+    char* dem =
+        abi::__cxa_demangle(info.dli_sname, nullptr, nullptr, &status);
+    if (status == 0 && dem) {
+      name = dem;
+      // folded format separators must not appear inside a frame name
+      for (char& c : name)
+        if (c == ';') c = ':';
+      std::free(dem);
+    } else {
+      name = info.dli_sname;
+      if (dem) std::free(dem);
+    }
+  } else if (dladdr(pc, &info) && info.dli_fname) {
+    const char* base = std::strrchr(info.dli_fname, '/');
+    char buf[128];
+    std::snprintf(buf, sizeof(buf), "%s+0x%zx",
+                  base ? base + 1 : info.dli_fname,
+                  (size_t)((char*)pc - (char*)info.dli_fbase));
+    name = buf;
+  } else {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "0x%zx", (size_t)pc);
+    name = buf;
+  }
+  cache[pc] = name;
+  return name;
+}
+
+// Flamegraph-ready collapsed stacks: one line per unique
+// (thread label, stack), root-first frames, space, count. Deterministic
+// order (sorted keys) so snapshot diffs are stable.
+inline std::string snapshot_folded() {
+  State& st = S();
+  std::lock_guard<std::mutex> g(st.agg_mu);
+  drain_all_locked();
+  std::ostringstream o;
+  for (const auto& [key, cnt] : st.agg) {
+    size_t z = key.find('\0');
+    if (z == std::string::npos) continue;
+    o << key.substr(0, z);
+    const char* raw = key.data() + z + 1;
+    size_t n = (key.size() - z - 1) / sizeof(void*);
+    // pcs are leaf-first (backtrace order); folded wants root-first
+    for (size_t i = n; i > 0; --i) {
+      void* pc;
+      std::memcpy(&pc, raw + (i - 1) * sizeof(void*), sizeof(void*));
+      o << ";" << symbolize(pc);
+    }
+    o << " " << cnt << "\n";
+  }
+  // loud-cap meta lines: coverage gaps travel WITH the evidence they
+  // degrade (a bundle consumer or flamegraph reader sees them inline)
+  uint64_t tf = st.table_full.load(std::memory_order_relaxed);
+  if (tf) o << "_prof.meta;unprofiled_threads_table_full " << tf << "\n";
+  uint64_t dr = st.dropped.load(std::memory_order_relaxed);
+  if (dr) o << "_prof.meta;samples_dropped_ring_overrun " << dr << "\n";
+  return o.str();
+}
+
+inline uint64_t samples_total() {
+  return S().samples.load(std::memory_order_relaxed);
+}
+
+inline void reset() {
+  State& st = S();
+  std::lock_guard<std::mutex> g(st.agg_mu);
+  // fast-forward every cursor so buffered-but-undrained samples from
+  // before the reset can't resurface in the next snapshot
+  for (int i = 0; i < kMaxThreads; ++i) {
+    ThreadRec& r = st.threads[i];
+    if (r.state.load(std::memory_order_acquire) == 2)
+      r.drained = r.head.load(std::memory_order_acquire);
+  }
+  st.agg.clear();
+  st.samples.store(0, std::memory_order_relaxed);
+  st.dropped.store(0, std::memory_order_relaxed);
+  st.table_full.store(0, std::memory_order_relaxed);
+}
+
+}  // namespace prof
+}  // namespace tft
+
+#endif  // TFT_PROFILER_H_

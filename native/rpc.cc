@@ -17,6 +17,7 @@
 #include "blackbox.h"     // crash-durable rpc.serve breadcrumbs
 #include "faultinject.h"  // env-gated injection points (torn frames, delays)
 #include "lathist.h"      // rpc.serve latency histogram
+#include "profiler.h"     // always-on sampling (rpc serve / quorum fan-out)
 
 namespace tft {
 
@@ -290,6 +291,10 @@ void RpcServer::accept_loop() {
       conns_.insert(fd);
       uint64_t id = next_thread_id_++;
       conn_threads_.emplace(id, std::thread([this, fd, id] {
+        // one guard covers the whole connection: rpc dispatch AND the
+        // ManagerSrv quorum fan-out both run on these threads, so their
+        // stacks land in the "rpc.serve" collapsed-stack bucket
+        prof::ThreadGuard prof_guard("rpc.serve");
         serve_conn(fd);
         std::lock_guard<std::mutex> g2(conns_mu_);
         conns_.erase(fd);

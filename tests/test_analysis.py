@@ -204,10 +204,36 @@ class TestWireDriftFixtures:
         assert "TORCHFT_TSDB_RETAIN" not in msgs
         assert "TORCHFT_REGRESSION_DELTA" not in msgs
 
+    def test_obs_env_covers_prof_and_diag_families(self):
+        # the ISSUE 12 satellite: the obs-env-drift rule must enforce
+        # the TORCHFT_PROF_* / TORCHFT_DIAG_* families in BOTH
+        # directions, like the six families before them
+        py = {
+            "a.py": 'os.environ.get("TORCHFT_PROF_HZ")\n'
+                    'os.environ.get("TORCHFT_PROF_GHOST")\n'
+                    'os.environ.get("TORCHFT_DIAG_DIR")\n'
+                    'os.environ.get("TORCHFT_DIAG_GHOST")\n',
+        }
+        doc = (
+            "| knob | default |\n"
+            "| `TORCHFT_PROF_HZ` | 11 |\n"
+            "| `TORCHFT_PROF_STALE` | 1 |\n"
+            "| `TORCHFT_DIAG_DIR` | unset |\n"
+            "| `TORCHFT_DIAG_STALE` | 1 |\n"
+        )
+        finds = wiredrift.check_obs_env(py, doc)
+        msgs = {f.symbol: f.message for f in finds}
+        for ghost in ("TORCHFT_PROF_GHOST", "TORCHFT_DIAG_GHOST"):
+            assert ghost in msgs and "missing from" in msgs[ghost]
+        for stale in ("TORCHFT_PROF_STALE", "TORCHFT_DIAG_STALE"):
+            assert stale in msgs and "no code reads" in msgs[stale]
+        assert "TORCHFT_PROF_HZ" not in msgs
+        assert "TORCHFT_DIAG_DIR" not in msgs
+
     def test_obs_env_clean_tree(self):
         # the live repo's observability knob families (SLO / straggler /
-        # blackbox / divergence / tsdb / regression) must match the
-        # docs/observability.md registries exactly
+        # blackbox / divergence / tsdb / regression / prof / diag) must
+        # match the docs/observability.md registries exactly
         finds = [f for f in wiredrift.run() if f.rule == "obs-env-drift"]
         assert finds == []
 

@@ -407,7 +407,7 @@ class TestFaultMatrix:
         "name",
         [
             "torn_cma_pull", "kill_allreduce_cma", "ckpt_serve_death",
-            "straggler_group", "perf_regression",
+            "straggler_group", "perf_regression", "diagnose_straggler",
         ],
     )
     def test_scenario(self, tmp_path, name):
@@ -419,6 +419,13 @@ class TestFaultMatrix:
             # the fleet straggler detector hosted by this process
             res = runner.run_straggler_scenario(
                 scn, str(tmp_path / name), steps=12, timeout_s=420
+            )
+        elif name == "diagnose_straggler":
+            # custom two-leg runner: the victim hosts its own detector +
+            # diagnosis engine, and the injected leg must auto-capture
+            # exactly one bundle (ISSUE 12)
+            res = runner.run_diagnose_scenario(
+                scn, str(tmp_path / name), steps=24, timeout_s=420
             )
         elif name == "perf_regression":
             # custom three-leg runner (control + mid-run onset +

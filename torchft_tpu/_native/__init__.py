@@ -49,7 +49,10 @@ _TIMEOUT_CODES = (CANCELLED, DEADLINE_EXCEEDED)
 # v6: fixed-retention time-series store (tsdb.h): tft_tsdb_snapshot/
 #     tft_tsdb_reset, lighthouse /timeseries.json + piggyback series
 #     ingest — an old build would silently drop every sample.
-_ABI_VERSION = 6
+# v7: always-on sampling profiler (profiler.h): tft_prof_set_hz/hz/
+#     snapshot/reset/samples_total + /diagnosis.json bundle index — an
+#     old build would fail the loader's symbol lookup at import.
+_ABI_VERSION = 7
 
 
 def _build(force: bool = False) -> None:
@@ -211,6 +214,20 @@ def _load() -> ctypes.CDLL:
     lib.tft_tsdb_snapshot.restype = c.c_int64
     lib.tft_tsdb_reset.argtypes = []
     lib.tft_tsdb_reset.restype = None
+
+    # always-on sampling profiler (native/profiler.h)
+    lib.tft_prof_set_hz.argtypes = [c.c_double]
+    lib.tft_prof_set_hz.restype = None
+    lib.tft_prof_hz.argtypes = []
+    lib.tft_prof_hz.restype = c.c_double
+    lib.tft_prof_snapshot.argtypes = [
+        c.POINTER(u8p), c.POINTER(c.c_int64), c.c_char_p, c.c_int,
+    ]
+    lib.tft_prof_snapshot.restype = c.c_int64
+    lib.tft_prof_samples_total.argtypes = []
+    lib.tft_prof_samples_total.restype = c.c_int64
+    lib.tft_prof_reset.argtypes = []
+    lib.tft_prof_reset.restype = None
 
     lib.tft_quorum_compute.argtypes = [
         u8p, c.c_int64, c.POINTER(u8p), c.POINTER(c.c_int64), c.c_char_p, c.c_int,
@@ -483,6 +500,46 @@ def tsdb_snapshot() -> Dict[str, Dict[str, Any]]:
 def tsdb_reset() -> None:
     """Clear the process time-series store (tests)."""
     _lib.tft_tsdb_reset()
+
+
+def prof_set_hz(hz: float) -> None:
+    """Retarget the native sampling profiler's rate live (0 pauses, >0
+    arms — the diagnosis engine's burst boost; see native/profiler.h)."""
+    _lib.tft_prof_set_hz(float(hz))
+
+
+def prof_hz() -> float:
+    """The native profiler's effective sampling rate (resolving the
+    ``TORCHFT_PROF_HZ`` env default on first call; 0 = disarmed)."""
+    return float(_lib.tft_prof_hz())
+
+
+def prof_snapshot() -> str:
+    """Flamegraph-ready collapsed stacks of every native sample drained
+    so far: ``"label;root;...;leaf count\\n"`` per unique (thread label,
+    stack), sorted. Cumulative — diff two snapshots
+    (:func:`torchft_tpu.telemetry.profiler.subtract_folded`) for a
+    bounded capture window."""
+    outp = ctypes.POINTER(ctypes.c_uint8)()
+    outlen = ctypes.c_int64()
+    err = _errbuf()
+    code = _lib.tft_prof_snapshot(
+        ctypes.byref(outp), ctypes.byref(outlen), err, _ERRLEN
+    )
+    if code != OK:
+        _raise_status(code, err.value.decode())
+    return _take_out(outp, outlen).decode(errors="replace")
+
+
+def prof_samples_total() -> int:
+    """Native samples aggregated since process start (or the last
+    :func:`prof_reset`)."""
+    return int(_lib.tft_prof_samples_total())
+
+
+def prof_reset() -> None:
+    """Drop every aggregated native sample (tests / capture windows)."""
+    _lib.tft_prof_reset()
 
 
 class _iovec(ctypes.Structure):

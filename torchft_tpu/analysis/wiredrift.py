@@ -281,8 +281,8 @@ def check_wire_env(
 
 
 _OBS_RE = re.compile(
-    r"TORCHFT_(?:SLO|STRAGGLER|BLACKBOX|DIVERGENCE|TSDB|REGRESSION)"
-    r"_[A-Z0-9_]+"
+    r"TORCHFT_(?:SLO|STRAGGLER|BLACKBOX|DIVERGENCE|TSDB|REGRESSION|PROF"
+    r"|DIAG)_[A-Z0-9_]+"
 )
 
 
@@ -290,13 +290,14 @@ def check_obs_env(
     py_texts: Dict[str, str], obs_doc_text: str
 ) -> List[Finding]:
     """The TORCHFT_SLO_* / TORCHFT_STRAGGLER_* / TORCHFT_BLACKBOX_* /
-    TORCHFT_DIVERGENCE_* / TORCHFT_TSDB_* / TORCHFT_REGRESSION_* knob
-    families vs the docs/observability.md knob registry, both directions
-    (the wire-env-drift contract for the step-anatomy, forensics,
-    divergence and history planes). The TSDB knobs are ALSO parsed by
-    the native store (tsdb.h getenv) — the Python references the rule
-    checks are the builder/client's shared constants, so both sides stay
-    on one registry."""
+    TORCHFT_DIVERGENCE_* / TORCHFT_TSDB_* / TORCHFT_REGRESSION_* /
+    TORCHFT_PROF_* / TORCHFT_DIAG_* knob families vs the
+    docs/observability.md knob registry, both directions (the
+    wire-env-drift contract for the step-anatomy, forensics, divergence,
+    history and diagnosis planes). The TSDB and PROF knobs are ALSO
+    parsed natively (tsdb.h / profiler.h getenv) — the Python references
+    the rule checks are the builder/client's shared constants, so both
+    sides stay on one registry."""
     py: Set[str] = set()
     for text in py_texts.values():
         py.update(_OBS_RE.findall(text))

@@ -239,6 +239,40 @@ SCENARIOS: List[Scenario] = [
         quick=False,
     ),
     Scenario(
+        name="diagnose_straggler",
+        description="+200ms collective.issue delay on group 1 (the "
+        "straggler_group signal) plus a 60ms native dp-hop delay on the "
+        "same victim: the victim's OWN straggler latch (it hosts a "
+        "FleetMonitor under TORCHFT_STRAGGLER_MONITOR=1) must auto-"
+        "capture exactly ONE diagnosis bundle into TORCHFT_DIAG_DIR "
+        "whose native collapsed stacks show the injected-delay frame "
+        "(fi::sleep_ms) dominant in the victim's dp.pump hot stack; the "
+        "survivor's engine must capture nothing (remote-subject filter); "
+        "an equal-length control soak captures ZERO bundles; checksums "
+        "bit-identical through the capture; the bundle round-trips "
+        "through `postmortem --bundles` (custom runner: "
+        "run_diagnose_scenario; --sanitize runs the same legs with the "
+        "jax-free worker to prove the new profiler ASan/TSan-clean)",
+        victim_schedule={
+            "seed": 8,
+            "rules": [
+                {
+                    "site": "collective.issue",
+                    "match": "allreduce",
+                    "every": 1,
+                    "action": "delay",
+                    "ms": 200,
+                }
+            ],
+        },
+        # native-layer delay on the same victim: lands inside the dp pump
+        # threads, which is exactly where the native sampler must find it
+        victim_env={"TORCHFT_FI_DP_DELAY_MS": "60"},
+        # forced tcp-striped so the dp plane (and its pump threads) runs
+        common_env={"TORCHFT_DP_CMA": "0"},
+        quick=False,
+    ),
+    Scenario(
         name="perf_regression",
         description="+150ms collective.issue delay injected on group 1 "
         "MID-RUN (the `after` onset rule): the perf-regression sentinel "
@@ -860,6 +894,252 @@ def run_straggler_scenario(
         scn.name, "passed",
         f"latched {victim_id} once (p50 {detected[0]['p50_s']}s vs "
         f"baseline {detected[0]['baseline_s']}s); control soak clean",
+        fired=fired,
+    )
+
+
+def run_diagnose_scenario(
+    scn: Scenario, workdir: str, steps: int = 24, timeout_s: float = 600.0,
+    extra_env: Optional[Dict[str, str]] = None,
+    worker_argv: Optional[List[str]] = None,
+) -> Result:
+    """The ``diagnose_straggler`` scenario (ISSUE 12): detection →
+    diagnosis, end to end, in the victim's own process.
+
+    **Injected leg** — group 1 submits every allreduce 200 ms late
+    (the straggler signal) AND delays every native dp hop 60 ms
+    (``TORCHFT_FI_DP_DELAY_MS`` — the frame the profiler must find).
+    BOTH workers host a FleetMonitor (``TORCHFT_STRAGGLER_MONITOR=1``,
+    factor 2.0, K=3) and a DiagnosisEngine (``TORCHFT_DIAG_DIR`` →
+    one shared fleet dir). The victim's own monitor latches
+    ``straggler_detected`` naming itself → its engine captures; the
+    survivor's monitor latches the SAME event naming the victim → its
+    engine's remote-subject filter drops it. Asserts: exactly ONE
+    bundle fleet-wide, written by the victim, whose ``native.folded``
+    shows the injected-delay frame (``fi::sleep_ms`` / nanosleep)
+    dominant in the victim's ``dp.pump`` hot stack (top stack by count,
+    and a majority share of pump samples); the bundle round-trips
+    through ``postmortem --bundles``; checksums stay bit-identical.
+
+    **Control leg** — identical env, no injection: ZERO bundles (the
+    false-capture gate — an autopilot attaching evidence to an eviction
+    must never fire on a healthy fleet).
+
+    Under ``--sanitize`` the same two legs run with the jax-free numpy
+    worker and the native profiler at 97 Hz (sampling pressure on the
+    SIGPROF handler/seqlock/drain paths under ASan/TSan). The numpy
+    worker's raw ``allreduce().wait()`` is not ledger-attributed as a
+    barrier phase, so the victim's delay inflates BOTH groups' local
+    time and the straggler compare cannot discriminate — the sanitized
+    legs trigger through the victim-only step-time SLO instead
+    (``TORCHFT_SLO_STEP_S``), which exercises the identical
+    latch→capture path; bundle capture is still asserted, but
+    stack-dominance is only checked when a native snapshot exists —
+    sanitizer scheduling skews sampling too much to gate on
+    percentages."""
+    from torchft_tpu.coordination import LighthouseServer
+
+    sanitized = worker_argv is not None
+    if sanitized:
+        # the SLO evaluator's min_events floor (8) sets the earliest
+        # possible latch; leave enough post-latch steps for the capture
+        # window to finish before the worker exits
+        steps = max(steps, 20)
+    # the jax-free sanitize worker names its replicas san_worker_<gid>
+    victim_id = "san_worker_1" if sanitized else "train_bytes_1"
+
+    def leg(name: str, inject: bool) -> "tuple[Optional[str], str, int]":
+        """One 2-group soak; returns (error, leg_diag_dir, fired)."""
+        wd = os.path.join(workdir, name)
+        os.makedirs(wd, exist_ok=True)
+        evidence_dir = os.path.join(wd, "evidence")
+        os.makedirs(evidence_dir, exist_ok=True)
+        leg_diag = os.path.join(wd, "diag")
+        os.makedirs(leg_diag, exist_ok=True)
+        with open(os.path.join(wd, "corpus.bin"), "wb") as f:
+            f.write(bytes(range(256)) * 24)
+        lighthouse = LighthouseServer(bind="[::]:0", min_replicas=2)
+        addr = lighthouse.address().split("//", 1)[-1]
+
+        def env_for(gid: int) -> Dict[str, str]:
+            env = dict(extra_env or {})
+            env.update(_worker_env(scn, gid))
+            env.update(
+                # one shared fleet dir: "exactly one bundle" is a
+                # fleet-wide claim, not a per-process one
+                TORCHFT_DIAG_DIR=leg_diag,
+                TORCHFT_DIAG_WINDOW_S="1.5",
+                TORCHFT_PROF_BURST_HZ="97",
+                # every group hosts the detector: the victim must latch
+                # ITSELF for the self-capture path to fire
+                TORCHFT_STRAGGLER_MONITOR="1",
+                TORCHFT_STRAGGLER_FACTOR="2.0",
+                TORCHFT_STRAGGLER_K="3",
+                TORCHFT_STRAGGLER_POLL_S="0.25",
+            )
+            if sanitized:
+                # sampling pressure on the new native paths is the point
+                env["TORCHFT_PROF_HZ"] = "97"
+                env["TORCHFT_DIAG_WINDOW_S"] = "0.75"
+                # see docstring: the straggler compare can't discriminate
+                # in the numpy worker — trigger via the victim-only SLO
+                env.pop("TORCHFT_STRAGGLER_MONITOR", None)
+                if gid == 1 and inject:
+                    env["TORCHFT_SLO_STEP_S"] = "0.01"
+            if not inject:
+                env.pop("TORCHFT_FAULT_SCHEDULE", None)
+                for k in [k for k in env if k.startswith("TORCHFT_FI_")]:
+                    env.pop(k)
+            return env
+
+        procs = {
+            0: _spawn(0, addr, wd, steps, env_for(0), worker_argv),
+            1: _spawn(1, addr, wd, steps, env_for(1), worker_argv),
+        }
+        deadline = time.monotonic() + timeout_s
+        err: Optional[str] = None
+        try:
+            while True:
+                done = {g: p.poll() for g, p in procs.items()}
+                for gid, rc in done.items():
+                    if rc is not None and rc != 0:
+                        err = (
+                            f"{name}: g{gid} rc={rc}; log tail: "
+                            f"{_read_log(wd, gid)[-1000:]}"
+                        )
+                        break
+                if err or all(rc is not None for rc in done.values()):
+                    break
+                if time.monotonic() > deadline:
+                    err = f"{name}: timeout after {timeout_s}s"
+                    break
+                time.sleep(0.25)
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+            lighthouse.shutdown()
+        if err is None:
+            cs_err, _sums = _final_checksums(wd)
+            if cs_err:
+                err = f"{name}: {cs_err}"
+        return err, leg_diag, len(read_evidence(evidence_dir))
+
+    err, diag_dir_inj, fired = leg("injected", inject=True)
+    if err:
+        return Result(scn.name, "failed", err, fired=fired)
+    if fired == 0:
+        return Result(
+            scn.name, "failed",
+            "no injection evidence recorded — the delay never fired",
+        )
+    from torchft_tpu.telemetry.diagnosis import read_bundles
+
+    bundles = read_bundles(diag_dir_inj)
+    expect_trigger = "slo_breach" if sanitized else "straggler_detected"
+    if (len(bundles) != 1) if not sanitized else (len(bundles) < 1):
+        return Result(
+            scn.name, "failed",
+            f"expected exactly one diagnosis bundle fleet-wide, got "
+            f"{len(bundles)}: {[b.get('bundle') for b in bundles]}",
+            fired=fired,
+        )
+    b = bundles[0]
+    trig = (b.get("trigger") or {}).get("event")
+    if trig != expect_trigger:
+        return Result(
+            scn.name, "failed",
+            f"bundle trigger is {trig!r}, not {expect_trigger} ({b})",
+            fired=fired,
+        )
+    replica = str(b.get("replica_id") or "")
+    if not replica.startswith(victim_id):
+        return Result(
+            scn.name, "failed",
+            f"bundle written by {replica!r}, not the victim "
+            f"{victim_id!r}* — the remote-subject filter failed",
+            fired=fired,
+        )
+    # the diagnosis claim itself: the victim's native hot stack names
+    # the injected delay. "Dominant" = the single most-sampled dp.pump
+    # stack carries the delay frame AND delay frames hold a majority of
+    # the victim's pump samples during the burst window.
+    try:
+        with open(
+            os.path.join(b["_dir"], "native.folded"), encoding="utf-8"
+        ) as f:
+            folded = f.read()
+    except OSError:
+        folded = ""
+    pump = [
+        (line.rpartition(" ")[0], int(line.rpartition(" ")[2]))
+        for line in folded.splitlines()
+        if line.startswith("dp.pump") and line.rpartition(" ")[2].isdigit()
+    ]
+    # the HOT stack = samples doing stripe work (run_stripe and below).
+    # A wall-clock sampler also sees the pump threads PARKED in their
+    # job cond-wait while the python-side issue delay holds the step
+    # back — that idleness is ambient truth, not the hot stack, and a
+    # flamegraph reader filters it the same way.
+    active = [(s, c) for s, c in pump if "run_stripe" in s]
+    if active:
+        total = sum(c for _s, c in active)
+        delayed = sum(
+            c for s, c in active if "sleep_ms" in s or "nanosleep" in s
+        )
+        top_stack = max(active, key=lambda sc: sc[1])[0]
+        top_has_delay = "sleep_ms" in top_stack or "nanosleep" in top_stack
+        if not top_has_delay or delayed * 2 < total:
+            return Result(
+                scn.name, "failed",
+                f"injected-delay frame not dominant in the victim's "
+                f"native hot stack: {delayed}/{total} active pump "
+                f"samples, top stack {top_stack[:200]!r}",
+                fired=fired,
+            )
+        dominance = (
+            f"{delayed}/{total} active pump samples in the delay frame"
+        )
+    elif not sanitized:
+        return Result(
+            scn.name, "failed",
+            "bundle carries no active dp.pump native stacks — the burst "
+            f"window sampled no stripe work (folded: {folded[:300]!r})",
+            fired=fired,
+        )
+    else:
+        dominance = "no active native stacks (sanitizer skew: ok)"
+    # round-trip: the postmortem CLI folds the bundle into the causal
+    # timeline (latch -> capture -> evidence) from disk alone
+    from torchft_tpu.telemetry import postmortem
+
+    report = postmortem.analyze(workdir, bundles_dir=diag_dir_inj)
+    caps = [
+        r for r in report["timeline"] if r.get("k") == "diagnosis_captured"
+    ]
+    if not report.get("bundles") or not caps:
+        return Result(
+            scn.name, "failed",
+            "postmortem --bundles did not fold the bundle into the "
+            f"timeline (bundles={report.get('bundles')})",
+            fired=fired,
+        )
+
+    ctl_err, diag_dir_ctl, _ = leg("control", inject=False)
+    if ctl_err:
+        return Result(scn.name, "failed", ctl_err, fired=fired)
+    ctl_bundles = read_bundles(diag_dir_ctl)
+    if ctl_bundles:
+        return Result(
+            scn.name, "failed",
+            f"control soak captured {len(ctl_bundles)} bundle(s) — "
+            f"false captures: {[b.get('bundle') for b in ctl_bundles]}",
+            fired=fired,
+        )
+    return Result(
+        scn.name, "passed",
+        f"one bundle by {replica} ({dominance}); postmortem round-trip "
+        "ok; control soak captured zero",
         fired=fired,
     )
 
@@ -1703,6 +1983,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             # fleet detector hosted by the runner process itself
             res = run_straggler_scenario(
                 scn, wd, steps=steps, timeout_s=args.timeout
+            )
+        elif scn.name == "diagnose_straggler":
+            # custom two-leg runner (injected + control soak): detection
+            # fires IN the victim (it hosts its own FleetMonitor) so the
+            # capture path is the production one. Sanitize-capable: same
+            # legs with the jax-free worker + the profiler at 97 Hz.
+            res = run_diagnose_scenario(
+                scn, wd, steps=steps, timeout_s=args.timeout,
+                extra_env=extra_env, worker_argv=worker_argv,
             )
         elif scn.name == "perf_regression":
             if args.sanitize:

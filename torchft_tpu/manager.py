@@ -429,6 +429,22 @@ class Manager:
         # the regular piggyback rides quorum RPCs, which a wedged step
         # never issues — exactly the scenario the stuck flag exists for
         self._watchdog = telemetry.StepWatchdog(on_stall=self._on_stall)
+        # Diagnosis plane (ISSUE 12): the Python stack sampler runs
+        # always-on at TORCHFT_PROF_HZ (0 disarms; the native sampler
+        # arms itself at thread registration), and — when
+        # TORCHFT_DIAG_DIR is set — a DiagnosisEngine turns latch events
+        # (straggler / perf-regression / SLO / watchdog / divergence)
+        # into bounded deep-capture bundles, announced on the piggyback.
+        from torchft_tpu.telemetry.diagnosis import DiagnosisEngine, diag_dir
+        from torchft_tpu.telemetry.profiler import PROFILER
+
+        PROFILER.ensure_started()
+        self._diagnosis: Optional[DiagnosisEngine] = None
+        if diag_dir():
+            self._diagnosis = DiagnosisEngine(
+                replica_id=self._replica_id,
+                lighthouse_addr=self._lighthouse_addr,
+            ).install()
         self._last_heal_ts = 0.0
         telemetry.TRACER.set_context(
             replica_id=self._replica_id, step=self._step, quorum_epoch=-1
@@ -586,6 +602,16 @@ class Manager:
                 "last_heal_ts": float(self._last_heal_ts),
                 "spans": telemetry.TRACER.drain_chrome_fragment(),
             }
+            # diagnosis-bundle availability (ISSUE 12): counts + the
+            # latest bundle name ride the same piggyback; the lighthouse
+            # serves the fleet index at GET /diagnosis.json (getattr:
+            # the payload builder must also work on partially-built
+            # Managers — tests drive it standalone)
+            diagnosis = getattr(self, "_diagnosis", None)
+            if diagnosis is not None and diagnosis.bundle_count:
+                payload["diag_bundles"] = diagnosis.bundle_count
+                payload["diag_last"] = diagnosis.last_bundle or ""
+                payload["diag_dir"] = diagnosis.directory or ""
             # per-step sample map for the lighthouse time-series store
             # (ISSUE 11): last step row's wall/local/phase seconds,
             # lathist quantiles and detector flags — telemetry/
@@ -736,6 +762,8 @@ class Manager:
         """Shut down the manager, checkpoint transport and data plane."""
         self._shutting_down = True
         self._watchdog.stop()
+        if self._diagnosis is not None:
+            self._diagnosis.remove()
         if self._fleet_monitor is not None:
             self._fleet_monitor.stop()
         if self._regression_monitor is not None:

@@ -327,6 +327,23 @@ PERF_REGRESSION_TOTAL = REGISTRY.counter(
     labelnames=("replica", "series"),
 )
 
+# diagnosis plane (ISSUE 12): always-on profiler samples (both planes)
+# and latch-triggered deep-capture bundles (telemetry/diagnosis.py)
+PROF_SAMPLES = REGISTRY.counter(
+    "tft_prof_samples_total",
+    "Always-on profiler samples aggregated, by plane (py = the "
+    "sys._current_frames thread sampler, native = the SIGPROF sampler "
+    "over the GIL-free planes; native counts fold in on poll — see "
+    "docs/observability.md 'Profiling & diagnosis bundles')",
+    labelnames=("plane",),
+)
+DIAGNOSIS_BUNDLES = REGISTRY.counter(
+    "tft_diagnosis_bundles_total",
+    "Latch-triggered diagnosis bundles written to TORCHFT_DIAG_DIR, by "
+    "trigger event",
+    labelnames=("trigger",),
+)
+
 # SLO / straggler plane (telemetry/slo.py)
 SLO_BREACH_TOTAL = REGISTRY.counter(
     "tft_slo_breach_total",
@@ -364,7 +381,9 @@ for _phase in PHASES:
     STEP_PHASE_SECONDS.labels(phase=_phase)
 for _slo in ("step_time", "rejoin_commit"):
     SLO_BREACH_TOTAL.labels(slo=_slo)
-del _role, _outcome, _kind, _result, _reason, _stage, _phase, _slo
+for _plane in ("py", "native"):
+    PROF_SAMPLES.labels(plane=_plane)
+del _role, _outcome, _kind, _result, _reason, _stage, _phase, _slo, _plane
 
 
 # ---------------------------------------------------------------------------

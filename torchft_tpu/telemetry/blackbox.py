@@ -206,6 +206,18 @@ class BlackBox:
         if emit_ctx:
             self.record("ctx", replica=replica_id)
 
+    def context(self) -> Dict[str, Any]:
+        """The current clock-sync-free coordinates (replica, epoch, step,
+        seq) — the diagnosis engine stamps bundles with these so capture
+        evidence merges onto the same timeline as everything else."""
+        with self._lock:
+            return {
+                "replica_id": self._replica_id,
+                "epoch": self._epoch,
+                "step": self._step,
+                "seq": self._seq,
+            }
+
     # -- producer --------------------------------------------------------
 
     def record(self, kind: str, **fields: Any) -> None:

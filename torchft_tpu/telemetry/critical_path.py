@@ -243,19 +243,27 @@ def set_reporter(attributor: Optional[CriticalPathAttributor]) -> None:
 
 
 def report_json() -> str:
-    """The /critical_path.json body (stable shape even with no monitor)."""
+    """The /critical_path.json body (stable shape even with no monitor).
+
+    The ``status`` field disambiguates the empty shapes explicitly
+    (ISSUE 12 satellite — this ambiguity bit the PR 11 bring-up once):
+    ``"no-monitor"`` = no CriticalPathMonitor ever installed itself here
+    (the route is served but nothing feeds it — check
+    ``TORCHFT_REGRESSION_MONITOR``), ``"empty"`` = a monitor is wired
+    but no step has been attributed yet, ``"ok"`` = live data."""
     import json
 
     with _REPORTER_LOCK:
         rep = REPORTER
     if rep is None:
         return json.dumps(
-            {"steps": 0, "blamed_total_s": 0.0, "blame": [],
-             "measured_steps_per_sec": None,
+            {"status": "no-monitor", "steps": 0, "blamed_total_s": 0.0,
+             "blame": [], "measured_steps_per_sec": None,
              "whatif_steps_per_sec": None, "monitor": False}
         )
     out = rep.report()
     out["monitor"] = True
+    out["status"] = "ok" if out.get("steps") else "empty"
     return json.dumps(out, separators=(",", ":"))
 
 

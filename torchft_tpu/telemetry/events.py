@@ -70,6 +70,7 @@ CANONICAL_EVENTS = (
     "blackbox_recovered",
     "perf_regression",
     "perf_regression_cleared",
+    "diagnosis_captured",
 )
 
 
@@ -83,6 +84,10 @@ class EventTrail:
         max_bytes: Optional[int] = None,
     ) -> None:
         self._lock = threading.Lock()
+        # live subscribers (the diagnosis trigger engine): called OUTSIDE
+        # the trail lock, exceptions swallowed — a consumer can never
+        # deadlock or fail the emitting step. guarded-by: _lock
+        self._subscribers: List[Any] = []
         self._ring: Deque[Dict[str, Any]] = deque(maxlen=maxlen)
         self._file: Optional[io.TextIOBase] = None
         self._path: Optional[str] = None
@@ -208,7 +213,36 @@ class EventTrail:
         from torchft_tpu.telemetry import FT_EVENTS_TOTAL
 
         FT_EVENTS_TOTAL.labels(event=event).inc()
+        # live fan-out (ISSUE 12): the diagnosis engine turns latch
+        # events into deep captures the moment they fire, instead of
+        # polling the ring. Outside the lock; failures swallowed. The
+        # unlocked emptiness check keeps the common no-subscriber
+        # deployment from paying a second lock acquire per event — safe
+        # because the list is only mutated under _lock (GIL-atomic ref
+        # read) and a stale-empty read just delays one delivery.
+        if self._subscribers:
+            with self._lock:
+                subscribers = list(self._subscribers)
+            for cb in subscribers:
+                try:
+                    cb(record)
+                except Exception:  # noqa: BLE001 — a consumer must never
+                    pass           # fail the emitting step
         return record
+
+    def subscribe(self, callback: Any) -> None:
+        """Register a live consumer: ``callback(record)`` runs on the
+        emitting thread after every :meth:`emit` (outside the trail
+        lock). Keep callbacks fast — heavy work belongs on the
+        consumer's own thread."""
+        with self._lock:
+            if callback not in self._subscribers:
+                self._subscribers.append(callback)
+
+    def unsubscribe(self, callback: Any) -> None:
+        with self._lock:
+            if callback in self._subscribers:
+                self._subscribers.remove(callback)
 
     # -- consumer side --
 

@@ -201,6 +201,14 @@ class FlightRecorder:
             payload["anatomy"] = LEDGER.dump()
         except Exception:  # noqa: BLE001 — never fail the dump path
             pass
+        # every live Python thread's stack rides along too (ISSUE 12
+        # satellite: one handler, one evidence dir — a hung fleet used to
+        # dump collective state but not WHERE each thread is parked,
+        # which is the first question a wedge postmortem asks)
+        try:
+            payload["py_stacks"] = _thread_stacks()
+        except Exception:  # noqa: BLE001
+            pass
         path = os.path.join(
             self.dump_dir(), f"tft_flight_{os.getpid()}_{seq}.json"
         )
@@ -226,6 +234,33 @@ def _hostname() -> str:
         return socket.gethostname()
     except OSError:
         return "?"
+
+
+def _thread_stacks() -> List[Dict[str, Any]]:
+    """Every live Python thread's current stack (root-first
+    ``file:line:function`` frames), named via threading.enumerate — the
+    wedge-localization snapshot the SIGUSR2 / deadline / watchdog dumps
+    carry."""
+    import sys
+    import traceback
+
+    names = {
+        t.ident: t.name for t in threading.enumerate() if t.ident is not None
+    }
+    out: List[Dict[str, Any]] = []
+    for tid, frame in sys._current_frames().items():
+        frames = [
+            f"{fs.filename.rsplit('/', 1)[-1]}:{fs.lineno}:{fs.name}"
+            for fs in traceback.extract_stack(frame)
+        ]
+        out.append(
+            {
+                "thread": names.get(tid, f"tid{tid}"),
+                "tid": tid,
+                "frames": frames,  # root-first
+            }
+        )
+    return out
 
 
 FLIGHT = FlightRecorder()

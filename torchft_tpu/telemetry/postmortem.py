@@ -163,12 +163,44 @@ def classify(
     return "clean"
 
 
+def collect_bundles(paths: List[Optional[str]]) -> List[Dict[str, Any]]:
+    """Every diagnosis bundle (``bundle.json``) under the given roots,
+    deduplicated and ordered by capture time — the ``--bundles`` input
+    (ISSUE 12). Torn/malformed bundles are skipped, same contract as the
+    black-box reader."""
+    from torchft_tpu.telemetry.diagnosis import load_bundle_meta
+
+    metas: List[Dict[str, Any]] = []
+    seen: set = set()
+    for p in paths:
+        if not p or not os.path.isdir(p):
+            continue
+        for base, _dirs, files in os.walk(p):
+            if "bundle.json" not in files:
+                continue
+            real = os.path.realpath(base)
+            if real in seen:
+                continue
+            seen.add(real)
+            meta = load_bundle_meta(base)
+            if meta is not None:
+                metas.append(meta)
+    metas.sort(key=lambda m: m.get("ts", 0.0))
+    return metas
+
+
 def analyze(
-    root: str, log_text: Optional[str] = None, timeline_cap: int = 2000
+    root: str,
+    log_text: Optional[str] = None,
+    timeline_cap: int = 2000,
+    bundles_dir: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Reconstruct the incident under ``root``; returns the report dict
     (JSON-safe). ``log_text`` optionally feeds worker-log text into the
-    environmental-signature classification."""
+    environmental-signature classification. ``bundles_dir`` (the
+    ``--bundles`` flag; ``""`` = discover under ``root``) folds captured
+    diagnosis bundles into the causal timeline, so the report reads
+    latch → capture → evidence even after every process died."""
     boxes = collect_boxes(root)
     evidence: List[Dict[str, Any]] = []
     trails: List[Dict[str, Any]] = []
@@ -231,6 +263,28 @@ def analyze(
                     },
                 }
             )
+    # diagnosis bundles fold in as first-class timeline records at their
+    # stamped (epoch, step, seq) coordinates: the latch event (mirrored
+    # by the trigger replica's box) is followed by its capture, and the
+    # record carries the on-disk evidence paths (ISSUE 12)
+    bundles: List[Dict[str, Any]] = []
+    if bundles_dir is not None:
+        bundles = collect_bundles([root, bundles_dir or None])
+        for meta in bundles:
+            trig = meta.get("trigger") or {}
+            timeline.append(
+                {
+                    "k": "diagnosis_captured",
+                    "ep": meta.get("epoch", -1),
+                    "st": meta.get("step", -1),
+                    "q": meta.get("seq", 0),
+                    "ts": meta.get("ts", 0.0),
+                    "src": meta.get("replica_id") or "diagnosis",
+                    "bundle": meta.get("bundle"),
+                    "trigger": trig.get("event"),
+                    "path": meta.get("_dir"),
+                }
+            )
     timeline.sort(key=_sort_key)
 
     # victim attribution: the replica the survivors' peer_death records
@@ -283,6 +337,10 @@ def analyze(
         },
         "first_anomaly": first_anomaly,
         "injected_evidence": injected,
+        "bundles": [
+            {k: v for k, v in m.items() if k not in ("lathist",)}
+            for m in bundles
+        ],
         "trails_mirrored_by_boxes": trails_mirrored,
         "timeline": timeline[:timeline_cap],
         "timeline_truncated": max(0, len(timeline) - timeline_cap),
@@ -451,6 +509,13 @@ def render_text(report: Dict[str, Any]) -> str:
             f"  injection evidence: {len(report['injected_evidence'])} "
             f"record(s) at {sites}"
         )
+    for m in report.get("bundles") or []:
+        trig = (m.get("trigger") or {}).get("event", "?")
+        lines.append(
+            f"  diagnosis bundle: {m.get('bundle')} (trigger={trig}, "
+            f"replica={m.get('replica_id')}, step={m.get('step')}, "
+            f"epoch={m.get('epoch')}) -> {m.get('_dir')}"
+        )
     return "\n".join(lines)
 
 
@@ -473,6 +538,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--window", type=int, default=0,
                     help="--perf: analyze only the last N steps per "
                     "replica (0 = all)")
+    ap.add_argument("--bundles", nargs="?", const="", default=None,
+                    metavar="DIR",
+                    help="fold diagnosis bundles (bundle.json dirs) into "
+                    "the causal timeline; with no DIR, discover them "
+                    "under the evidence dir itself")
     args = ap.parse_args(argv)
 
     if args.perf:
@@ -484,7 +554,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"report: {args.json_out}")
         return 0
 
-    report = analyze(args.dir)
+    report = analyze(args.dir, bundles_dir=args.bundles)
     print(render_text(report))
     if args.timeline:
         for rec in report["timeline"][-args.timeline:]:
