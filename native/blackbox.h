@@ -88,6 +88,9 @@ struct Ring {
 inline Ring& ring() {
   static Ring r;
   static std::atomic<int> state{0};  // 0 = uninit, 1 = armed, -1 = off
+  // release-order(fn): the final state store publishes the fully
+  // initialized mapping (base/cap written first); the acquire load
+  // pairs with it. The benign one-time-init race is documented below.
   int s = state.load(std::memory_order_acquire);
   if (s != 0) return r;
   // One-time init; a benign race here at worst re-runs the (idempotent)
@@ -141,6 +144,9 @@ inline void record(Site site, int64_t epoch, int64_t step, int64_t a,
                    int64_t b) {
   Ring& r = ring();
   if (r.base == nullptr) return;
+  // relaxed-ok: seq only allots slots; the readers are post-mortem
+  // (the mmap outlives the process), so no live happens-before exists
+  // to preserve — each record is CRC-framed against torn writes
   uint64_t seq = r.seq.fetch_add(1, std::memory_order_relaxed) + 1;
   uint8_t* slot = r.base + kHeaderSize + (size_t)(seq % r.cap) * kRecSize;
   uint64_t ts_ns = (uint64_t)std::chrono::duration_cast<

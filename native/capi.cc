@@ -294,6 +294,9 @@ int64_t tft_lathist_snapshot(uint8_t** out, int64_t* outlen, char* err,
                              int errlen) {
   try {
     Value resp = Value::M();
+    // relaxed-ok(fn): snapshot reads of the monotonic lathist counters
+    // (raw buckets merge exactly across processes; a concurrent
+    // observe skews one sample at most)
     for (int op = 0; op < lathist::kNumOps; ++op) {
       const lathist::Hist& h = lathist::get((lathist::Op)op);
       Value counts = Value::L();

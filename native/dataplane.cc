@@ -576,8 +576,9 @@ bool DataPlane::hop(int send_fd, int recv_fd, const uint8_t* sbuf, size_t sn,
 
 void DataPlane::enable_cma(const std::vector<int64_t>& pids) {
   peer_pids_ = pids;
-  // release-store publishes peer_pids_ to the already-running stripe
-  // workers (acquire-load in run_stripe); see the member comment
+  // release-order: the store publishes peer_pids_ to the already-
+  // running stripe workers (acquire-load in run_stripe); see the
+  // member comment
   cma_.store(true, std::memory_order_release);
 }
 
@@ -678,8 +679,9 @@ int DataPlane::run_stripe(int stripe_idx, Job& job, int* bad_peer,
   // CMA pulls exact f32 out of the peer's memory — the wire codec is
   // moot (and the exactness is deterministic: the owner's bytes are
   // distributed verbatim in the allgather phase)
-  // one acquire-load per job: pairs with enable_cma's release-store so
-  // peer_pids_ is fully visible before the first CMA hop of this job
+  // release-order: one acquire-load per job pairs with enable_cma's
+  // release-store so peer_pids_ is fully visible before the first CMA
+  // hop of this job
   const bool use_cma = cma_.load(std::memory_order_acquire);
   if (use_cma) job.codec = DpCodec::kF32;
   const DpCodec codec = job.codec;

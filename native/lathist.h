@@ -61,6 +61,9 @@ struct Hist {
   std::atomic<uint64_t> count{0};
 
   void observe_s(double seconds) {
+    // relaxed-ok(fn): monotonic stat counters — a reader may see a
+    // torn cross-counter view (one in-flight sample of skew between
+    // bucket/sum/count); scrape-side estimates, no ordering needed
     if (seconds < 0) seconds = 0;
     counts[bucket_index(seconds)].fetch_add(1, std::memory_order_relaxed);
     sum_ns.fetch_add((uint64_t)(seconds * 1e9), std::memory_order_relaxed);
@@ -68,6 +71,8 @@ struct Hist {
   }
 
   void reset() {
+    // relaxed-ok(fn): stat clear — concurrent observers may interleave
+    // with the zeroing, counts stay internally valid (never negative)
     for (auto& c : counts) c.store(0, std::memory_order_relaxed);
     sum_ns.store(0, std::memory_order_relaxed);
     count.store(0, std::memory_order_relaxed);
@@ -108,6 +113,8 @@ inline void reset_all() {
 // Interpolated quantile from the cumulative bucket counts (the scrape-side
 // histogram_quantile estimate; 0 when empty).
 inline double quantile(const Hist& h, double q) {
+  // relaxed-ok(fn): snapshot reads of monotonic counters — a
+  // scrape-side estimate, not an invariant; no ordering needed
   uint64_t counts[kNumBounds + 1];
   uint64_t total = 0;
   for (int i = 0; i <= kNumBounds; ++i) {
@@ -134,6 +141,8 @@ inline double quantile(const Hist& h, double q) {
 // Prometheus exposition under the native torchft_ prefix (le values are
 // exact powers of two; %.9g renders them round-trip-exact).
 inline void render_prometheus(std::ostringstream& o) {
+  // relaxed-ok(fn): snapshot reads of monotonic counters for the
+  // exposition text; a concurrent observe skews one bucket at most
   o << "# TYPE torchft_latency_seconds histogram\n";
   char buf[64];
   for (int op = 0; op < kNumOps; ++op) {
@@ -162,6 +171,8 @@ inline void render_prometheus(std::ostringstream& o) {
 // Compact JSON for /status.json: raw (non-cumulative) per-bucket counts so
 // a consumer can merge across processes exactly, plus p50/p99 convenience.
 inline void render_json(std::ostringstream& o) {
+  // relaxed-ok(fn): snapshot reads of monotonic counters (raw buckets
+  // merge exactly across processes; a concurrent observe skews one)
   char buf[64];
   o << "{";
   for (int op = 0; op < kNumOps; ++op) {

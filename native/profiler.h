@@ -113,6 +113,9 @@ inline State& S() {
 // positive) — and a 64-entry atomic scan is both async-signal-safe and
 // cheaper than it sounds (one pass per sample, not per hop).
 inline ThreadRec* find_self() {
+  // release-order(fn): (state, tid) acquire-loads pair with
+  // register_thread's release publication — the record's fields are
+  // fully written before state flips to 2
   long tid = (long)syscall(SYS_gettid);
   State& st = S();
   for (int i = 0; i < kMaxThreads; ++i) {
@@ -139,6 +142,9 @@ inline void sig_handler(int, siginfo_t*, void*) {
   int keep = n - kSkipFrames;
   if (keep < 0) keep = 0;
   if (keep > kMaxFrames) keep = kMaxFrames;
+  // relaxed-ok(fn): single-writer seqlock write side — the explicit
+  // release fence below orders the payload stores, and the even-seq +
+  // head release-stores publish the slot (see the seqlock comment)
   uint64_t h = r->head.load(std::memory_order_relaxed);
   Slot& s = r->ring[h % kRing];
   uint32_t q = s.seq.load(std::memory_order_relaxed);
@@ -160,6 +166,10 @@ inline void sig_handler(int, siginfo_t*, void*) {
 // ---- drain (under State::agg_mu) ------------------------------------------
 
 inline void drain_ring_locked(ThreadRec& r) {
+  // release-order(fn): seqlock read side — the head/seq acquire-loads
+  // pair with the handler's release stores; the relaxed payload loads
+  // are validated by the seq re-check under the acquire fence (a torn
+  // read fails the re-check and the slot is skipped)
   State& st = S();
   uint64_t head = r.head.load(std::memory_order_acquire);
   if (head > r.drained + kRing) {
@@ -192,6 +202,7 @@ inline void drain_all_locked() {
   State& st = S();
   for (int i = 0; i < kMaxThreads; ++i) {
     ThreadRec& r = st.threads[i];
+    // release-order: state==2 pairs with register_thread's publication
     if (r.state.load(std::memory_order_acquire) == 2) drain_ring_locked(r);
   }
 }
@@ -199,6 +210,9 @@ inline void drain_all_locked() {
 // ---- sampler ---------------------------------------------------------------
 
 inline void sampler_loop() {
+  // release-order(fn): sampler_pid/hz/state/tid acquire-loads pair with
+  // the release stores in ensure_running/set_hz/register_thread; a
+  // stale read only delays one tick or skips one retiring thread
   State& st = S();
   const long pid = (long)getpid();
   for (;;) {
@@ -245,6 +259,10 @@ inline void atfork_release() {
 }
 
 inline void ensure_running() {
+  // release-order(fn): double-checked arm — the relaxed re-read of
+  // sampler_pid runs under arm_mu (the mutex is the ordering there),
+  // and the pid release-store publishes handler install before the
+  // sampler thread's first acquire-load of it
   State& st = S();
   if (st.hz.load(std::memory_order_acquire) <= 0) return;  // disarmed
   const long pid = (long)getpid();
@@ -274,6 +292,9 @@ inline void ensure_running() {
 }
 
 inline void maybe_arm() {
+  // release-order(fn): double-checked hz arm — the relaxed re-read runs
+  // under arm_mu; the release store publishes env_hz to the acquire
+  // readers (current_hz, sampler_loop)
   State& st = S();
   if (st.hz.load(std::memory_order_acquire) < 0) {
     std::lock_guard<std::mutex> g(st.arm_mu);
@@ -284,11 +305,13 @@ inline void maybe_arm() {
 }
 
 inline double current_hz() {
+  // release-order: pairs with set_hz/maybe_arm release stores
   double hz = S().hz.load(std::memory_order_acquire);
   return hz < 0 ? 0.0 : hz;
 }
 
 inline void set_hz(double hz) {
+  // release-order: publishes hz to the sampler/arm acquire loads
   S().hz.store(hz, std::memory_order_release);
   if (hz > 0) ensure_running();
 }
@@ -296,6 +319,10 @@ inline void set_hz(double hz) {
 // ---- thread registration ---------------------------------------------------
 
 inline ThreadRec* register_thread(const char* label) {
+  // release-order(fn): the slot-claim CAS (acq_rel: pairs with
+  // unregister's release of state=0) and the relaxed ring scrub all
+  // happen-before the tid/state release publication that find_self and
+  // the sampler acquire-pair with
   maybe_arm();
   State& st = S();
   for (int i = 0; i < kMaxThreads; ++i) {
@@ -328,6 +355,9 @@ inline ThreadRec* register_thread(const char* label) {
 }
 
 inline void unregister_thread(ThreadRec* r) {
+  // release-order(fn): tid clear + state=0 release-publish the
+  // retirement (the next register's acq_rel CAS pairs with state); see
+  // the in-flight-SIGPROF comment below
   if (!r) return;
   // an in-flight SIGPROF to this thread stops matching once the tid
   // clears (a handler interrupting THIS function sees either the old
@@ -419,18 +449,24 @@ inline std::string snapshot_folded() {
   }
   // loud-cap meta lines: coverage gaps travel WITH the evidence they
   // degrade (a bundle consumer or flamegraph reader sees them inline)
+  // relaxed-ok: monotonic stat counters, no ordering needed
   uint64_t tf = st.table_full.load(std::memory_order_relaxed);
   if (tf) o << "_prof.meta;unprofiled_threads_table_full " << tf << "\n";
+  // relaxed-ok: monotonic stat counter, no ordering needed
   uint64_t dr = st.dropped.load(std::memory_order_relaxed);
   if (dr) o << "_prof.meta;samples_dropped_ring_overrun " << dr << "\n";
   return o.str();
 }
 
 inline uint64_t samples_total() {
+  // relaxed-ok: monotonic stat counter, no ordering needed
   return S().samples.load(std::memory_order_relaxed);
 }
 
 inline void reset() {
+  // relaxed-ok(fn): the counter clears run under agg_mu (the mutex is
+  // the ordering); the state/head acquire-loads pair with the
+  // registration/handler release stores
   State& st = S();
   std::lock_guard<std::mutex> g(st.agg_mu);
   // fast-forward every cursor so buffered-but-undrained samples from

@@ -1,13 +1,21 @@
 #!/usr/bin/env bash
 # Pre-merge gate — the checklist that used to live only as prose in
-# docs/static_analysis.md, as one runnable script (ISSUE 11):
+# docs/static_analysis.md, as one runnable script (ISSUE 11, extended by
+# ISSUE 15):
 #
-#   1. the static-analysis gate  (python -m torchft_tpu.analysis)
-#   2. the native strict-warning build  (make -C native warn, -Werror)
-#   3. the quick faultmatrix subset  (runner --quick)
+#   1. the static-analysis gate  (python -m torchft_tpu.analysis —
+#      concurrency lint, wire/doc drift, and the clang-free native
+#      concurrency lint)
+#   2. the native strict-warning build  (make -C native warn, -Werror);
+#      when clang-tidy is on PATH the full `make -C native tidy` gate
+#      runs too instead of being silently skipped
+#   3. the quick faultmatrix subset  (runner --quick) — every scenario
+#      now also replays spec-conformance-clean or fails
 #   4. the profiler-overhead smoke  (armed-at-default-Hz vs disarmed
-#      headline leg, gate <=2% — ISSUE 12; the always-on claim stays a
-#      measured fact, not an assumption)
+#      headline leg, gate <=2% — ISSUE 12)
+#   5. the protocol verification gate (ISSUE 15): exhaustive bounded
+#      model check of the quorum/commit spec (crash at every transition
+#      point) + a conformance replay of the quick matrix's trails
 #
 # Exit 0 = every gate clean. Each gate runs even if an earlier one
 # failed, so one invocation reports the full damage; the exit code is
@@ -16,8 +24,9 @@
 # "can I even propose this diff" check.
 #
 # Usage:
-#   scripts/premerge.sh              # all four gates
-#   scripts/premerge.sh --no-matrix  # skip the faultmatrix (seconds-fast)
+#   scripts/premerge.sh              # all five gates
+#   scripts/premerge.sh --no-matrix  # skip the faultmatrix (seconds-fast;
+#                                    # gate 5 then skips the replay leg)
 #   scripts/premerge.sh --no-smoke   # skip the profiler-overhead smoke
 set -u -o pipefail
 
@@ -37,28 +46,41 @@ done
 rc=0
 fail() { echo "premerge: GATE FAILED: $1" >&2; rc=1; }
 
-echo "=== [1/4] static-analysis gate (python -m torchft_tpu.analysis) ==="
+echo "=== [1/5] static-analysis gate (python -m torchft_tpu.analysis) ==="
 if ! JAX_PLATFORMS=cpu python -m torchft_tpu.analysis; then
   fail "analysis"
 fi
 
-echo "=== [2/4] native strict-warning build (make -C native warn) ==="
+echo "=== [2/5] native strict-warning build (make -C native warn) ==="
 if ! make -C native warn; then
   fail "native warn"
 fi
+# the real clang-tidy gate, when the toolchain is present: exit-3
+# (clang-tidy missing) stays a skip with a message, but a container
+# that HAS clang-tidy runs the full baseline-diffed gate — no more
+# silently weaker checking on better-equipped boxes
+if command -v clang-tidy >/dev/null 2>&1; then
+  echo "--- clang-tidy present: running make -C native tidy"
+  if ! make -C native tidy; then
+    fail "native tidy"
+  fi
+else
+  echo "--- clang-tidy not on PATH: tidy gate skipped (make warn ran)"
+fi
 
+MATRIX_DIR="${TMPDIR:-/tmp}/premerge_faultmatrix"
 if [ "$RUN_MATRIX" = 1 ]; then
-  echo "=== [3/4] quick faultmatrix subset (runner --quick) ==="
+  echo "=== [3/5] quick faultmatrix subset (runner --quick) ==="
   if ! JAX_PLATFORMS=cpu python -m torchft_tpu.faultinject.runner --quick \
-      --outdir "${TMPDIR:-/tmp}/premerge_faultmatrix"; then
+      --outdir "$MATRIX_DIR"; then
     fail "faultmatrix --quick"
   fi
 else
-  echo "=== [3/4] faultmatrix skipped (--no-matrix) ==="
+  echo "=== [3/5] faultmatrix skipped (--no-matrix) ==="
 fi
 
 if [ "$RUN_SMOKE" = 1 ]; then
-  echo "=== [4/4] profiler-overhead smoke (armed vs disarmed, gate <=2%) ==="
+  echo "=== [4/5] profiler-overhead smoke (armed vs disarmed, gate <=2%) ==="
   # a single short leg on a loaded box can swing past the gate on
   # weather (the row's own note says so) — one breach earns one retry,
   # and only a breach on BOTH runs fails the gate
@@ -71,7 +93,17 @@ if [ "$RUN_SMOKE" = 1 ]; then
     fi
   fi
 else
-  echo "=== [4/4] profiler-overhead smoke skipped (--no-smoke) ==="
+  echo "=== [4/5] profiler-overhead smoke skipped (--no-smoke) ==="
+fi
+
+echo "=== [5/5] protocol verification (model check + conformance replay) ==="
+PROTO_ARGS=()
+if [ "$RUN_MATRIX" = 1 ] && [ -d "$MATRIX_DIR" ]; then
+  PROTO_ARGS+=(--conformance "$MATRIX_DIR")
+fi
+if ! JAX_PLATFORMS=cpu python -m torchft_tpu.analysis.protocol \
+    ${PROTO_ARGS[@]+"${PROTO_ARGS[@]}"}; then
+  fail "protocol verification"
 fi
 
 if [ "$rc" = 0 ]; then

@@ -1,0 +1,40 @@
+// Seeded bug for the native concurrency lint: a blocking syscall under a
+// held guard (reply_locked — the PR 9 serve_one reply-under-mutex class)
+// plus a bare cv.wait with no predicate outside any loop. The ok_* twins
+// must NOT be flagged: the send happens after the guard scope closes,
+// and the predicate-overload wait self-checks.
+#include <condition_variable>
+#include <mutex>
+#include <sys/socket.h>
+
+class Server {
+ public:
+  void reply_locked(int fd, const char* buf, int n) {
+    std::lock_guard<std::mutex> g(mu_);
+    pending_--;
+    send(fd, buf, n, 0);
+  }
+
+  void reply_ok(int fd, const char* buf, int n) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      pending_--;
+    }
+    send(fd, buf, n, 0);
+  }
+
+  void wait_bad() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk);
+  }
+
+  void wait_ok() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return pending_ == 0; });
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int pending_ = 0;
+};

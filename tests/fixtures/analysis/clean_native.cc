@@ -1,0 +1,45 @@
+// Clean twin for the native concurrency lint: consistent lock order,
+// blocking work outside guards, predicate-loop cv waits, annotated
+// atomics. Must produce ZERO findings.
+#include <atomic>
+#include <condition_variable>
+#include <mutex>
+#include <sys/socket.h>
+
+class Worker {
+ public:
+  void submit() {
+    std::lock_guard<std::mutex> g(mu_a_);
+    std::lock_guard<std::mutex> g2(mu_b_);
+    jobs_++;
+  }
+
+  void finish() {
+    std::lock_guard<std::mutex> g(mu_a_);
+    std::lock_guard<std::mutex> g2(mu_b_);
+    jobs_--;
+    cv_.notify_all();
+  }
+
+  void drain_then_send(int fd, const char* buf, int n) {
+    {
+      std::unique_lock<std::mutex> lk(mu_b_);
+      while (jobs_ > 0) {
+        cv_.wait(lk);
+      }
+    }
+    send(fd, buf, n, 0);
+  }
+
+  unsigned long ticks() const {
+    // relaxed-ok: monotonic stat counter, no ordering needed
+    return ticks_.load(std::memory_order_relaxed);
+  }
+
+ private:
+  std::mutex mu_a_;
+  std::mutex mu_b_;
+  std::condition_variable cv_;
+  std::atomic<unsigned long> ticks_{0};
+  int jobs_ = 0;
+};

@@ -1,0 +1,28 @@
+// Seeded bug for the native concurrency lint: a lock-order inversion.
+// thread A: push() takes mu_a_ then (via refill) mu_b_;
+// thread B: drain() takes mu_b_ then mu_a_ — opposing order, deadlock.
+#include <mutex>
+
+class Queue {
+ public:
+  void push() {
+    std::lock_guard<std::mutex> g(mu_a_);
+    refill();
+  }
+
+  void refill() {
+    std::lock_guard<std::mutex> g(mu_b_);
+    depth_++;
+  }
+
+  void drain() {
+    std::lock_guard<std::mutex> g(mu_b_);
+    std::lock_guard<std::mutex> g2(mu_a_);
+    depth_--;
+  }
+
+ private:
+  std::mutex mu_a_;
+  std::mutex mu_b_;
+  int depth_ = 0;
+};

@@ -1,0 +1,13 @@
+# Seeded bug for the makefile-hdrs-drift rule: the header list is
+# missing newthing.h (its edits would silently ship a stale .so — the
+# tsdb.h/profiler.h incident class) and still lists gone.h, which no
+# longer exists.
+CXX ?= g++
+SRCS := core.cc
+HDRS := wire.h rpc.h \
+        gone.h
+
+all: lib.so
+
+lib.so: $(SRCS) $(HDRS)
+	$(CXX) -shared -o $@ $(SRCS)

@@ -1,0 +1,20 @@
+// Seeded bug for the native concurrency lint: a non-seq_cst atomic op
+// with no `// relaxed-ok:` / `// release-order:` reason annotation
+// (bump_bad), next to a correctly annotated one (bump_ok) that must NOT
+// be flagged.
+#pragma once
+#include <atomic>
+
+struct Counters {
+  std::atomic<unsigned long> hits{0};
+  std::atomic<unsigned long> misses{0};
+};
+
+inline void bump_bad(Counters& c) {
+  c.hits.fetch_add(1, std::memory_order_relaxed);
+}
+
+inline void bump_ok(Counters& c) {
+  // relaxed-ok: monotonic stat counter, no ordering needed
+  c.misses.fetch_add(1, std::memory_order_relaxed);
+}

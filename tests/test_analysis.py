@@ -19,7 +19,7 @@ import sys
 import pytest
 
 from torchft_tpu.analysis import Baseline, run_all
-from torchft_tpu.analysis import concurrency, docdrift, wiredrift
+from torchft_tpu.analysis import concurrency, docdrift, nativelint, wiredrift
 from torchft_tpu.analysis.__main__ import main as analysis_main
 from torchft_tpu.analysis.base import Finding
 
@@ -350,4 +350,79 @@ class TestRepoGate:
         doc = json.loads(proc.stdout)
         assert doc["ok"] is True
         assert set(doc["analyzers"]) == {"concurrency", "wiredrift",
-                                         "docdrift"}
+                                         "docdrift", "nativelint"}
+
+
+# ---------------------------------------------------------------------------
+# native lint fixtures (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def _native_fixture_findings(*names):
+    sources = []
+    for name in names:
+        with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+            sources.append((name, f.read()))
+    return nativelint.analyze_sources(sources)
+
+
+class TestNativeLintFixtures:
+    def test_lock_order_cycle_caught(self):
+        finds = _native_fixture_findings("lock_cycle.cc")
+        hits = [f for f in finds if f.rule == "cpp-lock-order-cycle"]
+        assert hits, [f.render() for f in finds]
+        # the cycle names both mutexes, and the cross-function edge
+        # (push -> refill propagation) is what closes it
+        assert "mu_a_" in hits[0].symbol and "mu_b_" in hits[0].symbol
+
+    def test_blocking_under_lock_caught(self):
+        finds = _native_fixture_findings("blocking_lock.cc")
+        hits = [f for f in finds if f.rule == "cpp-blocking-under-lock"]
+        assert [f.symbol for f in hits] == ["Server::reply_locked:send"]
+
+    def test_cv_wait_no_loop_caught(self):
+        finds = _native_fixture_findings("blocking_lock.cc")
+        hits = [f for f in finds if f.rule == "cpp-cv-wait-no-loop"]
+        assert len(hits) == 1 and "wait_bad" in hits[0].symbol
+        # the predicate-overload twin is NOT flagged
+        assert not [f for f in finds if "wait_ok" in f.symbol]
+
+    def test_unannotated_relaxed_atomic_caught(self):
+        finds = _native_fixture_findings("relaxed_atomic.h")
+        hits = [f for f in finds
+                if f.rule == "cpp-atomic-no-order-reason"]
+        assert [f.symbol for f in hits] == ["bump_bad:relaxed"]
+
+    def test_clean_native_fixture_passes_every_rule(self):
+        finds = _native_fixture_findings("clean_native.cc")
+        assert finds == [], [f.render() for f in finds]
+
+    def test_makefile_hdrs_drift_fixture(self):
+        with open(os.path.join(FIXTURES, "makefile_hdrs_drift.mk")) as f:
+            mk = f.read()
+        finds = wiredrift.check_makefile_hdrs(
+            mk, ["wire.h", "rpc.h", "newthing.h"]
+        )
+        by_symbol = {f.symbol: f.message for f in finds}
+        assert set(by_symbol) == {"newthing.h", "gone.h"}
+        assert "stale" in by_symbol["newthing.h"]
+        assert "does not exist" in by_symbol["gone.h"]
+
+    def test_makefile_hdrs_clean_tree(self):
+        """Every real native/*.h is in the real Makefile's HDRS."""
+        finds = [
+            f for f in wiredrift.run()
+            if f.rule == "makefile-hdrs-drift"
+        ]
+        assert finds == [], [f.render() for f in finds]
+
+    def test_native_tree_lints_clean_through_baseline(self):
+        """The real native tree: every finding baselined, none active
+        (the repo-gate test covers this too; this one names the
+        analyzer so a nativelint regression reads as itself)."""
+        finds = nativelint.run()
+        baseline = Baseline.load(
+            os.path.join(REPO, "torchft_tpu", "analysis", "baseline.json")
+        )
+        active, _suppressed, _stale = baseline.apply(finds)
+        assert active == [], [f.render() for f in active]

@@ -1,0 +1,326 @@
+"""Tests for the FT-protocol verification plane (ISSUE 15).
+
+Three layers, mirroring the package:
+
+* **model checker** — the shipped gate configurations must verify clean
+  under exhaustive bounded exploration (crash injected at every
+  transition point), and every deliberately-broken spec variant (the
+  seeded fixtures) must produce exactly its planted violation class —
+  the checker is itself code under test, so both directions matter;
+* **trace conformance** — each illegal-transition rule catches its
+  seeded trail (the ``trail_healing_commit.jsonl`` fixture et al.) and
+  passes legal lifecycles, including the SIGKILL+respawn append pattern
+  real faultmatrix trails produce;
+* **the CLI** — ``python -m torchft_tpu.analysis.protocol`` is premerge
+  gate [5]; its exit-code contract is pinned here.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from torchft_tpu.analysis.protocol import SpecConfig, check
+from torchft_tpu.analysis.protocol.checker import GATE_CONFIGS
+from torchft_tpu.analysis.protocol.conformance import (
+    check_records,
+    check_trail_file,
+)
+
+REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
+
+
+def _kinds(result):
+    return sorted({v.invariant for v in result.violations})
+
+
+# ---------------------------------------------------------------------------
+# model checker: the shipped protocol verifies clean
+# ---------------------------------------------------------------------------
+
+
+class TestModelChecker:
+    def test_sync_2g_clean(self):
+        r = check(GATE_CONFIGS["sync-2g"])
+        assert r.ok, [v.render() for v in r.violations]
+        # exhaustive means EXPLORED: a broken scheduler that visits 3
+        # states would also report "no violations"
+        assert r.states > 1000
+        assert r.terminals > 0
+
+    def test_pipelined_2g_clean(self):
+        r = check(GATE_CONFIGS["pipelined-2g"])
+        assert r.ok, [v.render() for v in r.violations]
+        assert r.states > 1000
+
+    def test_divergence_fenced_2g_clean(self):
+        r = check(GATE_CONFIGS["divergence-fenced-2g"])
+        assert r.ok, [v.render() for v in r.violations]
+        assert r.states > 1000
+
+    # sync-3g (~100k states) runs in premerge gate [5], not tier-1.
+
+    def test_crash_interleaved_at_every_point(self):
+        """The SIGKILL-anywhere contract: with a crash budget, the
+        explored transition multiset contains a crash from many distinct
+        predecessor depths — spot-check by counting crash transitions."""
+        from torchft_tpu.analysis.protocol.spec import (
+            enabled_actions,
+            init_state,
+        )
+
+        cfg = GATE_CONFIGS["sync-2g"]
+        state = init_state(cfg)
+        labels = [a for a, _s in enabled_actions(state, cfg)]
+        assert "crash(0)" in labels and "crash(1)" in labels
+        # take a non-crash step; the crash action must still be offered
+        _label, nxt = next(
+            (a, s) for a, s in enabled_actions(state, cfg)
+            if a.startswith("join")
+        )
+        labels2 = [a for a, _s in enabled_actions(nxt, cfg)]
+        assert "crash(0)" in labels2 and "crash(1)" in labels2
+
+
+# ---------------------------------------------------------------------------
+# model checker: every broken variant is caught (seeded spec fixtures)
+# ---------------------------------------------------------------------------
+
+
+class TestBrokenVariantsCaught:
+    def test_double_commit_fixture(self):
+        """The seeded split-brain spec (join barrier off) must produce
+        the double-commit interleaving — and the same bounds with the
+        barrier ON must not."""
+        with open(os.path.join(FIXTURES, "spec_double_commit.json")) as f:
+            doc = json.load(f)
+        expect = doc.pop("expect_violation")
+        doc.pop("_comment")
+        broken = SpecConfig(**doc)
+        r = check(broken)
+        assert expect in _kinds(r), _kinds(r)
+        # the violation comes with an executable reproduction trace
+        bad = next(v for v in r.violations if v.invariant == expect)
+        assert any(t.startswith("form(") for t in bad.trace)
+        fixed = SpecConfig(**{**doc, "join_barrier": True})
+        assert check(fixed).ok
+
+    def test_speculation_fence_load_bearing(self):
+        """PR 3: fence off -> a healer observes speculative state."""
+        broken = SpecConfig(
+            n_replicas=2, min_replicas=1, max_rounds=3, crash_budget=1,
+            respawn_budget=1, speculation=True, fence_speculation=False,
+        )
+        assert "I3-healer-fence" in _kinds(check(broken))
+        fixed = SpecConfig(
+            n_replicas=2, min_replicas=1, max_rounds=3, crash_budget=1,
+            respawn_budget=1, speculation=True,
+        )
+        assert check(fixed).ok
+
+    def test_residual_rollback_load_bearing(self):
+        """PR 6: a vetoed speculative update must roll the
+        error-feedback residual back with the weights."""
+        broken = SpecConfig(
+            n_replicas=2, min_replicas=1, max_rounds=2, crash_budget=1,
+            respawn_budget=0, speculation=True, rollback_residual=False,
+        )
+        assert "I4-residual-rollback" in _kinds(check(broken))
+
+    def test_divergence_fence_load_bearing(self):
+        """PR 10: sentinel/fence off -> a silently-corrupt compute
+        commits a second lineage."""
+        broken = SpecConfig(
+            n_replicas=2, min_replicas=1, max_rounds=2, crash_budget=0,
+            respawn_budget=0, corrupt_budget=1, fence_divergence=False,
+        )
+        assert "I1-unique-commit" in _kinds(check(broken))
+
+
+# ---------------------------------------------------------------------------
+# trace conformance
+# ---------------------------------------------------------------------------
+
+
+class TestConformance:
+    def test_healing_commit_fixture_caught(self):
+        rep = check_trail_file(
+            os.path.join(FIXTURES, "trail_healing_commit.jsonl")
+        )
+        assert [f.rule for f in rep.findings] == ["healing-commit"]
+        assert rep.findings[0].step == 4
+
+    def test_legal_lifecycle_passes(self):
+        legal = [
+            {"event": "quorum_start", "step": 0},
+            {"event": "quorum_ready", "quorum_id": 1, "step": 0},
+            {"event": "commit", "step": 0},
+            {"event": "quorum_start", "step": 1},
+            {"event": "quorum_ready", "quorum_id": 2, "step": 1},
+            {"event": "heal_begin", "step": 5},
+            {"event": "heal_end", "step": 5},
+            {"event": "commit", "step": 5},
+            {"event": "abort", "step": 6},
+            {"event": "quorum_start", "step": 6},
+            {"event": "quorum_ready", "quorum_id": 3, "step": 6},
+            {"event": "commit", "step": 6},
+        ]
+        rep = check_records(legal, "legal")
+        assert rep.ok, [f.render() for f in rep.findings]
+
+    def test_respawn_append_pattern_legal(self):
+        """A respawned worker appends to the same trail: its step-0
+        quorum_start resets per-process trackers, so re-healing and
+        re-committing an already-seen step is legal — but the epoch
+        must stay monotone across the respawn."""
+        records = [
+            {"event": "quorum_ready", "quorum_id": 3, "step": 0},
+            {"event": "commit", "step": 0},
+            {"event": "commit", "step": 1},
+            # process died; respawn starts over
+            {"event": "quorum_start", "step": 0},
+            {"event": "quorum_ready", "quorum_id": 7, "step": 0},
+            {"event": "heal_begin", "step": 1},
+            {"event": "heal_end", "step": 1},
+            {"event": "commit", "step": 1},
+        ]
+        assert check_records(records).ok
+        # same pattern with a REGRESSING epoch after respawn: illegal
+        bad = list(records)
+        bad[4] = {"event": "quorum_ready", "quorum_id": 2, "step": 0}
+        rep = check_records(bad)
+        assert [f.rule for f in rep.findings] == ["epoch-regression"]
+
+    def test_epoch_regression_caught(self):
+        rep = check_records([
+            {"event": "quorum_ready", "quorum_id": 5, "step": 0},
+            {"event": "quorum_ready", "quorum_id": 4, "step": 0},
+        ])
+        assert [f.rule for f in rep.findings] == ["epoch-regression"]
+
+    def test_double_commit_caught(self):
+        rep = check_records([
+            {"event": "quorum_ready", "quorum_id": 1, "step": 0},
+            {"event": "commit", "step": 2},
+            {"event": "commit", "step": 2},
+        ])
+        assert [f.rule for f in rep.findings] == ["step-regression"]
+
+    def test_heal_failed_then_commit_caught(self):
+        rep = check_records([
+            {"event": "quorum_ready", "quorum_id": 1, "step": 0},
+            {"event": "heal_begin", "step": 2},
+            {"event": "heal_failed", "step": 2},
+            {"event": "commit", "step": 2},
+        ])
+        assert [f.rule for f in rep.findings] == ["heal-failed-commit"]
+        # ... but a commit after the NEXT quorum is the legal retry
+        rep2 = check_records([
+            {"event": "quorum_ready", "quorum_id": 1, "step": 0},
+            {"event": "heal_begin", "step": 2},
+            {"event": "heal_failed", "step": 2},
+            {"event": "quorum_ready", "quorum_id": 2, "step": 0},
+            {"event": "heal_begin", "step": 2},
+            {"event": "heal_end", "step": 2},
+            {"event": "commit", "step": 2},
+        ])
+        assert rep2.ok, [f.render() for f in rep2.findings]
+
+    def test_fence_veto_bypass_caught(self):
+        rep = check_records([
+            {"event": "quorum_ready", "quorum_id": 1, "step": 0},
+            {"event": "divergence_detected", "step": 3, "fence": True},
+            {"event": "commit", "step": 3},
+        ])
+        assert [f.rule for f in rep.findings] == ["diverged-commit"]
+        # sentinel-only (fence unarmed): the commit is the documented
+        # detect-don't-veto mode — legal
+        rep2 = check_records([
+            {"event": "quorum_ready", "quorum_id": 1, "step": 0},
+            {"event": "divergence_detected", "step": 3, "fence": False},
+            {"event": "commit", "step": 3},
+        ])
+        assert rep2.ok
+        # the real fence flow (corrupt_divergence fence leg): veto ->
+        # abort -> RE-QUORUM -> clean retry of the same step commits
+        rep3 = check_records([
+            {"event": "quorum_ready", "quorum_id": 1, "step": 0},
+            {"event": "divergence_detected", "step": 4, "fence": True},
+            {"event": "abort", "step": 4},
+            {"event": "quorum_ready", "quorum_id": 1, "step": 4},
+            {"event": "commit", "step": 4},
+        ])
+        assert rep3.ok, [f.render() for f in rep3.findings]
+
+    def test_rollback_of_commit_caught(self):
+        rep = check_records([
+            {"event": "quorum_ready", "quorum_id": 1, "step": 0},
+            {"event": "commit", "step": 3},
+            {"event": "commit_rollback", "step": 3},
+        ])
+        assert [f.rule for f in rep.findings] == ["rollback-of-commit"]
+        # the legal veto pairing: abort then rollback, never committed
+        rep2 = check_records([
+            {"event": "quorum_ready", "quorum_id": 1, "step": 0},
+            {"event": "abort", "step": 3},
+            {"event": "commit_rollback", "step": 3},
+        ])
+        assert rep2.ok
+
+    def test_blackbox_record_shape_accepted(self):
+        """Black-box mirror records use the compact {k, st, ep} shape;
+        the normalizer maps them onto the same rules."""
+        rep = check_records([
+            {"k": "quorum_ready", "quorum_id": 5, "st": 0},
+            {"k": "quorum_ready", "quorum_id": 4, "st": 0},
+        ])
+        assert [f.rule for f in rep.findings] == ["epoch-regression"]
+
+
+# ---------------------------------------------------------------------------
+# CLI (premerge gate [5])
+# ---------------------------------------------------------------------------
+
+
+class TestProtocolCli:
+    def test_conformance_only_exit_codes(self, tmp_path):
+        clean = tmp_path / "clean"
+        clean.mkdir()
+        (clean / "trail0.jsonl").write_text(
+            '{"event": "quorum_ready", "quorum_id": 1, "step": 0}\n'
+            '{"event": "commit", "step": 0}\n'
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchft_tpu.analysis.protocol",
+             "--skip-model", "--conformance", str(clean)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        bad = tmp_path / "bad"
+        bad.mkdir()
+        (bad / "trail0.jsonl").write_text(
+            '{"event": "quorum_ready", "quorum_id": 1, "step": 0}\n'
+            '{"event": "heal_begin", "step": 2}\n'
+            '{"event": "commit", "step": 2}\n'
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchft_tpu.analysis.protocol",
+             "--skip-model", "--conformance", str(bad)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "healing-commit" in proc.stdout
+
+    def test_model_check_cli_single_config(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchft_tpu.analysis.protocol",
+             "--config", "sync-2g", "--json"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["ok"] is True
+        assert doc["model"]["sync-2g"]["violations"] == []
+        assert doc["model"]["sync-2g"]["states"] > 1000

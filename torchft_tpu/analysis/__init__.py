@@ -3,7 +3,7 @@ the test tier can't see.
 
 Run it as ``python -m torchft_tpu.analysis`` (single exit code, human or
 ``--json`` output, checked-in baseline at ``analysis/baseline.json``).
-Three analyzers:
+Four analyzers:
 
 * :mod:`~torchft_tpu.analysis.concurrency` — AST concurrency lint over
   the FT runtime modules (lock-order cycles, blocking/callback calls
@@ -11,9 +11,18 @@ Three analyzers:
   ``Condition.wait`` predicate loops, thread hygiene);
 * :mod:`~torchft_tpu.analysis.wiredrift` — C++ ↔ Python protocol drift
   (wire tags, status codes, RPC opcodes, ``TORCHFT_FI_*`` knobs, fault
-  site labels, ``.pyi`` stub coverage);
+  site labels, ``.pyi`` stub coverage, Makefile HDRS coverage);
 * :mod:`~torchft_tpu.analysis.docdrift` — the bidirectional doc/registry
-  catalogs (metrics, events, fault sites).
+  catalogs (metrics, events, fault sites);
+* :mod:`~torchft_tpu.analysis.nativelint` — the clang-free lexical
+  concurrency lint over ``native/*.{h,cc}`` (lock-order graph,
+  blocking-syscall-under-lock, cv predicate loops, non-seq_cst atomic
+  annotations).
+
+The FT-protocol verification plane (executable spec + bounded model
+checker + trace conformance) lives in
+:mod:`~torchft_tpu.analysis.protocol` with its own CLI
+(``python -m torchft_tpu.analysis.protocol``, premerge gate [5]).
 
 See ``docs/static_analysis.md`` for the rule catalog and the baseline
 workflow.
@@ -41,10 +50,16 @@ __all__ = [
 
 def run_all(root: Optional[str] = None) -> Dict[str, List[Finding]]:
     """Run every analyzer; returns findings per analyzer (pre-baseline)."""
-    from torchft_tpu.analysis import concurrency, docdrift, wiredrift
+    from torchft_tpu.analysis import (
+        concurrency,
+        docdrift,
+        nativelint,
+        wiredrift,
+    )
 
     return {
         "concurrency": concurrency.run(root),
         "wiredrift": wiredrift.run(root),
         "docdrift": docdrift.run(root),
+        "nativelint": nativelint.run(root),
     }

@@ -69,7 +69,10 @@ from torchft_tpu.analysis.base import Finding, repo_root
 
 __all__ = ["RUNTIME_MODULES", "analyze_source", "analyze_paths", "run"]
 
-# The modules whose threading contract this lint enforces (ISSUE 5 list).
+# The modules whose threading contract this lint enforces: the ISSUE 5
+# list plus every thread-spawning module landed since (ISSUE 15 — the
+# diagnosis/profiler/SLO/time-series monitors and the black box all run
+# worker threads against Manager-visible state).
 RUNTIME_MODULES = (
     "torchft_tpu/manager.py",
     "torchft_tpu/futures.py",
@@ -79,6 +82,12 @@ RUNTIME_MODULES = (
     "torchft_tpu/telemetry/flight.py",
     "torchft_tpu/checkpointing/_rwlock.py",
     "torchft_tpu/faultinject/core.py",
+    "torchft_tpu/telemetry/diagnosis.py",
+    "torchft_tpu/telemetry/profiler.py",
+    "torchft_tpu/telemetry/slo.py",
+    "torchft_tpu/telemetry/timeseries.py",
+    "torchft_tpu/telemetry/blackbox.py",
+    "torchft_tpu/telemetry/critical_path.py",
 )
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}

@@ -1,0 +1,709 @@
+"""Clang-free concurrency lint over the native C++ core (``native/*.{h,cc}``).
+
+clang-tidy exits 3 on this container (g++ only), which left ~6.7k LoC of
+lock-discipline-critical C++ with zero static checking — the exact gap the
+PR 9 ``serve_one`` reply-under-mutex finding fell through. This module is
+the PR 5 Python concurrency lint ported to a lexical C++ analyzer: no
+compiler, no AST — a comment/string-stripped token scan with brace-context
+tracking, which is enough for the four rules below because the codebase's
+locking idiom is uniform (``std::lock_guard``/``std::unique_lock`` guards
+named in-scope, mutexes declared as ``std::mutex`` members).
+
+Rules (ids are the suppression-key prefix, like the Python lint):
+
+``cpp-lock-order-cycle``
+    A cycle in the global (cross-file) lock-order graph built from nested
+    guard scopes and one level of call propagation: holding ``A`` while a
+    statement (or a callee, resolved by unique short name across the
+    native tree) acquires ``B`` adds the edge ``A -> B``. Lock identity
+    is class-qualified (``Lighthouse::mu_`` is not ``RpcClient::mu_``);
+    a mutex member name declared by several classes and acquired through
+    an object expression collapses to the instance-agnostic ``*.name``
+    like the Python lint.
+
+``cpp-blocking-under-lock``
+    A blocking syscall/helper (``send``/``recv``/``poll``/``connect``/
+    ``accept``/``select``, the repo's ``send_all``/``recv_all``/
+    ``write_all`` wire helpers, ``sleep_for``/``usleep``, thread
+    ``.join()``, ``RpcClient::call``) — or a call to a same-tree function
+    that blocks — while a guard is held. ``cv.wait`` on the held lock is
+    exempt (it releases); documented-intentional cases (a dedicated
+    per-socket send mutex) are baselined with a reason.
+
+``cpp-cv-wait-no-loop``
+    A ``condition_variable`` ``wait``/``wait_for``/``wait_until`` (or the
+    repo's ``cv_wait_deadline``) **without** a predicate argument and not
+    lexically inside a ``while``/``for``/``do`` loop — wakeups may be
+    spurious.
+
+``cpp-atomic-no-order-reason``
+    A non-seq_cst atomic operation (any explicit ``memory_order_relaxed``
+    / ``acquire`` / ``release`` / ``acq_rel`` / ``consume``, including
+    ``atomic_thread_fence``) with no reason annotation. The annotation
+    grammar (same shape as the Python lint's ``guarded-by``)::
+
+        seq.store(q + 1, std::memory_order_relaxed);  // relaxed-ok: <why>
+        // release-order: head publishes the slot written above
+        head.store(h + 1, std::memory_order_release);
+
+    A trailing comment or the contiguous comment block directly above the
+    op counts; ``// relaxed-ok(fn): <why>`` (or ``release-order(fn):``)
+    anywhere earlier in the same function annotates every remaining op in
+    that function — the form the seqlock protocols use, where one
+    paragraph explains a dozen ops. One finding per (function, order)
+    keeps suppression keys stable across edits.
+
+Run via ``python -m torchft_tpu.analysis`` (the single repo gate) or
+directly: ``run()`` returns :class:`~torchft_tpu.analysis.base.Finding`
+records under the same baseline contract as every other analyzer.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from torchft_tpu.analysis.base import Finding, repo_root
+
+__all__ = ["NATIVE_GLOBS", "analyze_sources", "run"]
+
+NATIVE_GLOBS = ("native/*.h", "native/*.cc")
+
+# Blocking call names: syscalls + the repo's own wire helpers. `read`/
+# `write` are deliberately excluded (too many innocent homonyms for a
+# lexical pass); the *_all helpers cover the wire paths that matter.
+_BLOCKING_FUNCS = {
+    "send", "recv", "sendmsg", "recvmsg", "accept", "connect", "poll",
+    "select", "epoll_wait", "usleep", "nanosleep", "send_all", "recv_all",
+    "write_all", "read_full", "tcp_connect", "getaddrinfo", "sleep_for",
+    "sleep_until",
+}
+# method names that block regardless of receiver type resolution
+_BLOCKING_METHODS = {"join", "call", "sleep_for", "sleep_until"}
+
+_WAIT_NAMES = {"wait", "wait_for", "wait_until"}
+
+_GUARD_RE = re.compile(
+    r"std::(?:lock_guard|unique_lock|scoped_lock)\s*(?:<[^>]*>)?\s+"
+    r"(\w+)\s*\(([^;{]*)\)"
+)
+_MUTEX_DECL_RE = re.compile(
+    r"(?:static\s+)?std::(?:recursive_)?mutex\s+(\w+)\s*;"
+)
+_CV_DECL_RE = re.compile(r"std::condition_variable(?:_any)?\s+(\w+)\s*;")
+_ORDER_RE = re.compile(
+    r"memory_order(?:::|_)(relaxed|acquire|release|acq_rel|consume)"
+)
+_ANNOT_RE = re.compile(r"//\s*(?:relaxed-ok|release-order):\s*\S")
+_ANNOT_FN_RE = re.compile(r"//\s*(?:relaxed-ok|release-order)\(fn\):\s*\S")
+_CALL_RE = re.compile(r"([A-Za-z_]\w*)\s*\(")
+_LAMBDA_TAIL_RE = re.compile(
+    r"\[[^\[\]]*\]\s*(?:\([^()]*\))?\s*"
+    r"(?:mutable|noexcept|constexpr|->\s*[\w:<>,&*\s]+)*\s*$"
+)
+_CLASS_RE = re.compile(r"\b(?:class|struct)\s+(\w+)[^;{()]*$")
+_NAMESPACE_RE = re.compile(r"\bnamespace\s+(\w*)\s*$")
+_FUNC_NAME_RE = re.compile(r"([A-Za-z_][\w]*(?:::~?[A-Za-z_]\w*)*)\s*\(")
+_CONTROL_KWS = {"if", "while", "for", "switch", "catch", "return",
+                "sizeof", "new", "delete", "throw", "do", "else",
+                "defined", "assert", "static_assert"}
+
+
+def _strip(source: str) -> str:
+    """Replace comments and string/char literals with spaces, preserving
+    newlines (so positions map back to true line numbers)."""
+    out: List[str] = []
+    i, n = 0, len(source)
+    mode = "code"  # code | line_comment | block_comment | str | chr
+    while i < n:
+        c = source[i]
+        nxt = source[i + 1] if i + 1 < n else ""
+        if mode == "code":
+            if c == "/" and nxt == "/":
+                mode = "line_comment"
+                out.append("  ")
+                i += 2
+                continue
+            if c == "/" and nxt == "*":
+                mode = "block_comment"
+                out.append("  ")
+                i += 2
+                continue
+            if c == '"':
+                mode = "str"
+                out.append(" ")
+                i += 1
+                continue
+            if c == "'":
+                mode = "chr"
+                out.append(" ")
+                i += 1
+                continue
+            out.append(c)
+        elif mode == "line_comment":
+            if c == "\n":
+                mode = "code"
+                out.append("\n")
+            else:
+                out.append(" ")
+        elif mode == "block_comment":
+            if c == "*" and nxt == "/":
+                mode = "code"
+                out.append("  ")
+                i += 2
+                continue
+            out.append("\n" if c == "\n" else " ")
+        else:  # str / chr
+            q = '"' if mode == "str" else "'"
+            if c == "\\":
+                out.append("  ")
+                i += 2
+                continue
+            if c == q:
+                mode = "code"
+            out.append("\n" if c == "\n" else " ")
+        i += 1
+    return "".join(out)
+
+
+def _top_level_args(argtext: str) -> List[str]:
+    """Split a call's argument text on top-level commas."""
+    args: List[str] = []
+    depth = 0
+    cur: List[str] = []
+    for c in argtext:
+        if c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth = max(0, depth - 1)
+        if c == "," and depth == 0:
+            args.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(c)
+    tail = "".join(cur).strip()
+    if tail:
+        args.append(tail)
+    return args
+
+
+def _balanced_args(text: str, open_paren: int) -> str:
+    """Argument text of the call whose ``(`` sits at ``open_paren``."""
+    depth = 0
+    for j in range(open_paren, len(text)):
+        if text[j] == "(":
+            depth += 1
+        elif text[j] == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_paren + 1:j]
+    return text[open_paren + 1:]
+
+
+class _Scope:
+    __slots__ = ("kind", "name", "held", "loop")
+
+    def __init__(self, kind: str, name: str = "", loop: bool = False) -> None:
+        self.kind = kind      # class | namespace | func | lambda | block
+        self.name = name
+        self.held: List[str] = []  # locks acquired IN this scope
+        self.loop = loop           # block opened by while/for/do
+
+
+class _Func:
+    __slots__ = ("qual", "path", "start", "end", "cls", "acquires",
+                 "blocks", "calls")
+
+    def __init__(self, qual: str, path: str, start: int, cls: str) -> None:
+        self.qual = qual
+        self.path = path
+        self.start = start
+        self.end = start
+        self.cls = cls                        # owning class ('' for free)
+        self.acquires: List[Tuple[str, int]] = []
+        self.blocks: Optional[str] = None     # first blocking label
+        # (callee short name, line, locks held at the call)
+        self.calls: List[Tuple[str, int, Tuple[str, ...]]] = []
+
+
+class _Analyzer:
+    """All native files analyzed together (cross-file propagation needs
+    the global function/mutex index)."""
+
+    def __init__(self) -> None:
+        self.findings: List[Finding] = []
+        self.funcs: Dict[str, List[_Func]] = {}      # short name -> defs
+        self.mutex_owners: Dict[str, Set[str]] = {}  # name -> owner set
+        self.cv_names: Set[str] = set()
+        # lock-order edge -> first (path, line, holder qualname)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    # ------------------------------------------------------------------
+    # pass 0: declarations (mutexes + condition variables, with owners)
+    # ------------------------------------------------------------------
+
+    def scan_decls(self, path: str, code: str) -> None:
+        stack: List[Tuple[str, str]] = []  # (kind, name) per '{'
+        seg_start = 0
+        for i, c in enumerate(code):
+            if c not in ";{}":
+                continue
+            seg = code[seg_start:i]
+            if c == "{":
+                m = _CLASS_RE.search(seg)
+                mn = _NAMESPACE_RE.search(seg)
+                if m:
+                    stack.append(("class", m.group(1)))
+                elif mn:
+                    stack.append(("namespace", mn.group(1)))
+                else:
+                    stack.append(("other", ""))
+            elif c == "}":
+                if stack:
+                    stack.pop()
+            else:  # ';' — a declaration statement
+                owner = next(
+                    (n for k, n in reversed(stack) if k == "class"), ""
+                )
+                dm = _MUTEX_DECL_RE.search(seg + ";")
+                if dm:
+                    self.mutex_owners.setdefault(dm.group(1), set()).add(
+                        owner or f"<{os.path.basename(path)}>"
+                    )
+                dc = _CV_DECL_RE.search(seg + ";")
+                if dc:
+                    self.cv_names.add(dc.group(1))
+            seg_start = i + 1
+
+    # ------------------------------------------------------------------
+    # lock identity
+    # ------------------------------------------------------------------
+
+    def lock_id(self, expr: str, cls: str) -> Optional[str]:
+        """Resolve a guard's mutex expression to a stable lock id, or
+        None when the expression doesn't name a declared mutex."""
+        expr = expr.strip().lstrip("*&").strip()
+        leaf = re.split(r"\.|->|::", expr)[-1].strip().strip("()& ")
+        if not leaf or leaf not in self.mutex_owners:
+            return None
+        owners = self.mutex_owners[leaf]
+        plain = re.fullmatch(r"\w+", expr) is not None
+        if plain and cls and cls in owners:
+            return f"{cls}::{leaf}"
+        if len(owners) == 1:
+            return f"{next(iter(owners))}::{leaf}"
+        return f"*.{leaf}"
+
+    # ------------------------------------------------------------------
+    # pass 1: per-file walk
+    # ------------------------------------------------------------------
+
+    def analyze_file(self, path: str, raw: str, code: str) -> None:
+        raw_lines = raw.splitlines()
+        stack: List[_Scope] = []
+        cur_func: Optional[_Func] = None
+        func_depth = 0
+        guard_locks: Dict[str, str] = {}  # guard var -> lock id
+        line = 1
+        seg_start = 0
+
+        def enclosing_class() -> str:
+            for s in reversed(stack):
+                if s.kind == "class":
+                    return s.name
+            return ""
+
+        def held_now() -> List[str]:
+            """Locks visible at this point: everything acquired in scopes
+            inside the current function/lambda frame."""
+            held: List[str] = []
+            for s in reversed(stack):
+                held = s.held + held
+                if s.kind in ("func", "lambda"):
+                    break
+            return held
+
+        def in_loop() -> bool:
+            for s in reversed(stack):
+                if s.kind in ("func", "lambda"):
+                    return False
+                if s.loop:
+                    return True
+            return False
+
+        def acquire(lid: str, at_line: int, scope: _Scope) -> None:
+            assert cur_func is not None
+            for h in held_now():
+                if h != lid:
+                    self.edges.setdefault(
+                        (h, lid), (path, at_line, cur_func.qual)
+                    )
+            cur_func.acquires.append((lid, at_line))
+            scope.held.append(lid)
+
+        def release(lid: str) -> None:
+            for s in reversed(stack):
+                if lid in s.held:
+                    s.held.remove(lid)
+                    return
+                if s.kind in ("func", "lambda"):
+                    return
+
+        def handle_stmt(seg: str, seg_line: int) -> None:
+            if cur_func is None or not stack:
+                return
+            scope = stack[-1]
+            # guard declarations
+            for m in _GUARD_RE.finditer(seg):
+                var, args = m.group(1), m.group(2)
+                for a in _top_level_args(args):
+                    if a in ("std::defer_lock", "std::try_to_lock",
+                             "std::adopt_lock"):
+                        continue
+                    lid = self.lock_id(a, cur_func.cls)
+                    if lid is not None:
+                        acquire(lid, seg_line, scope)
+                        guard_locks[var] = lid
+                        break  # first resolvable arg is the mutex
+            # manual lock()/unlock() on guard vars or mutexes
+            for m in re.finditer(
+                r"([\w.\->()]+?)\s*\.\s*(lock|unlock)\s*\(\s*\)", seg
+            ):
+                target, op = m.group(1), m.group(2)
+                lid = guard_locks.get(target) or self.lock_id(
+                    target, cur_func.cls
+                )
+                if lid is None:
+                    continue
+                if op == "lock":
+                    acquire(lid, seg_line, scope)
+                else:
+                    release(lid)
+            handle_calls(seg, seg_line)
+
+        def handle_calls(seg: str, seg_line: int) -> None:
+            assert cur_func is not None
+            held = held_now()
+            pos = 0
+            while True:
+                m = _CALL_RE.search(seg, pos)
+                if m is None:
+                    break
+                name = m.group(1)
+                start = m.start(1)
+                pos = m.end()
+                if name in _CONTROL_KWS or name in (
+                    "lock_guard", "unique_lock", "scoped_lock",
+                    "lock", "unlock",
+                ):
+                    continue
+                prefix = seg[:start].rstrip()
+                is_method = prefix.endswith(".") or prefix.endswith("->")
+                recv = ""
+                if is_method:
+                    rm = re.search(r"([\w\].()\->]+)(?:\.|->)$", prefix)
+                    recv = rm.group(1) if rm else ""
+                args = _top_level_args(_balanced_args(seg, m.end() - 1))
+
+                # cv waits: exempt from blocking (they release the lock)
+                # but subject to the predicate-loop rule
+                recv_leaf = re.split(r"\.|->", recv)[-1] if recv else ""
+                wait_like = (
+                    (is_method and name in _WAIT_NAMES
+                     and recv_leaf in self.cv_names)
+                    or name == "cv_wait_deadline"
+                )
+                if wait_like:
+                    has_pred = (
+                        (name in _WAIT_NAMES and len(args) >= 2)
+                        or (name == "cv_wait_deadline" and len(args) >= 4)
+                    )
+                    if not has_pred and not in_loop():
+                        self.findings.append(Finding(
+                            "cpp-cv-wait-no-loop", path, seg_line,
+                            f"{cur_func.qual}:{recv_leaf or name}",
+                            "condition-variable wait without a predicate "
+                            "and outside a while/for loop — wakeups may "
+                            "be spurious",
+                        ))
+                    continue
+
+                if name in _BLOCKING_FUNCS or (
+                    is_method and name in _BLOCKING_METHODS
+                ):
+                    label = f"{recv + '.' if recv else ''}{name}"
+                    if cur_func.blocks is None:
+                        cur_func.blocks = label
+                    if held:
+                        self.findings.append(Finding(
+                            "cpp-blocking-under-lock", path, seg_line,
+                            f"{cur_func.qual}:{label}",
+                            f"blocking call {label}() while holding "
+                            f"{'+'.join(held)} — every thread contending "
+                            "that lock waits out the slow path too",
+                        ))
+                    continue
+                cur_func.calls.append((name, seg_line, tuple(held)))
+
+        def classify_open(seg: str) -> _Scope:
+            if cur_func is not None:
+                if _LAMBDA_TAIL_RE.search(seg):
+                    # lambda body: executes later, possibly on another
+                    # thread — locks held at the definition site do not
+                    # surround it (matches the Python lint's nested-def
+                    # semantics)
+                    return _Scope("lambda")
+                loop = bool(re.search(r"\b(while|for)\s*\(", seg)) or \
+                    seg.strip().endswith("do") or seg.strip() == "do"
+                return _Scope("block", loop=loop)
+            m = _CLASS_RE.search(seg)
+            if m:
+                return _Scope("class", m.group(1))
+            mn = _NAMESPACE_RE.search(seg)
+            if mn:
+                return _Scope("namespace", mn.group(1))
+            for fm in _FUNC_NAME_RE.finditer(seg):
+                name = fm.group(1)
+                if name.split("::")[-1] in _CONTROL_KWS:
+                    continue
+                return _Scope("func", name)
+            return _Scope("block")
+
+        i, n = 0, len(code)
+        paren = 0           # paren depth within the current brace scope
+        paren_stack: List[int] = []  # saved depth per enclosing '{'
+        while i < n:
+            c = code[i]
+            if c == "\n":
+                line += 1
+                i += 1
+                continue
+            if c == "(":
+                paren += 1
+                i += 1
+                continue
+            if c == ")":
+                paren = max(0, paren - 1)
+                i += 1
+                continue
+            if c not in ";{}":
+                i += 1
+                continue
+            if c == ";" and paren > 0:
+                # a ';' inside a paren group (for(;;) headers) is not a
+                # statement boundary
+                i += 1
+                continue
+            seg = code[seg_start:i]
+            seg_line = line - seg.count("\n")
+            if c == ";":
+                handle_stmt(seg, seg_line)
+            elif c == "{":
+                paren_stack.append(paren)
+                paren = 0
+                scope = classify_open(seg)
+                if scope.kind == "func" and cur_func is None:
+                    qual = scope.name
+                    cls = enclosing_class()
+                    if "::" in qual:
+                        cls = qual.split("::")[-2]
+                    elif cls:
+                        # in-class definition (header style): qualify so
+                        # findings read Class::method like .cc methods
+                        qual = f"{cls}::{qual}"
+                    f = _Func(qual, path, seg_line, cls)
+                    self.funcs.setdefault(qual.split("::")[-1], []).append(f)
+                    cur_func = f
+                    func_depth = len(stack)
+                    guard_locks = {}
+                elif cur_func is not None:
+                    # text before an inner block still executes in order
+                    # (e.g. `if (client.call(...)) {` / `while (recv(...))`)
+                    handle_stmt(seg, seg_line)
+                stack.append(scope)
+            else:  # '}'
+                paren = paren_stack.pop() if paren_stack else 0
+                if stack:
+                    stack.pop()
+                    if cur_func is not None and len(stack) == func_depth:
+                        cur_func.end = line
+                        cur_func = None
+                        guard_locks = {}
+            seg_start = i + 1
+            i += 1
+
+        self._atomic_rule(path, raw_lines)
+
+    # ------------------------------------------------------------------
+    # pass 2: atomics annotation rule (raw lines — comments matter here)
+    # ------------------------------------------------------------------
+
+    def _atomic_rule(self, path: str, raw_lines: List[str]) -> None:
+        spans: List[Tuple[int, int, str]] = []
+        for defs in self.funcs.values():
+            for f in defs:
+                if f.path == path:
+                    spans.append((f.start, f.end, f.qual))
+        spans.sort()
+
+        def func_at(lineno: int) -> Tuple[int, int, str]:
+            best = (0, 10 ** 9, "<file>")
+            for s, e, q in spans:
+                if s <= lineno <= e and (e - s) < (best[1] - best[0]):
+                    best = (s, e, q)
+            return best
+
+        fn_marker: Dict[Tuple[str, int], int] = {}  # (qual, start) -> line
+        for idx, text in enumerate(raw_lines, start=1):
+            if _ANNOT_FN_RE.search(text):
+                s, _e, q = func_at(idx)
+                fn_marker[(q, s)] = min(fn_marker.get((q, s), idx), idx)
+
+        missing: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        for idx, text in enumerate(raw_lines, start=1):
+            orders = set(_ORDER_RE.findall(text))
+            if not orders:
+                continue
+            if _ANNOT_RE.search(text) or _ANNOT_FN_RE.search(text):
+                continue
+            j = idx - 2  # contiguous comment block directly above
+            annotated = False
+            while j >= 0 and raw_lines[j].strip().startswith("//"):
+                if _ANNOT_RE.search(raw_lines[j]) or _ANNOT_FN_RE.search(
+                    raw_lines[j]
+                ):
+                    annotated = True
+                    break
+                j -= 1
+            if annotated:
+                continue
+            s, _e, q = func_at(idx)
+            if (q, s) in fn_marker and idx >= fn_marker[(q, s)]:
+                continue
+            for order in orders:
+                first, count = missing.get((q, order), (idx, 0))
+                missing[(q, order)] = (first, count + 1)
+        for (q, order), (first, count) in sorted(missing.items()):
+            self.findings.append(Finding(
+                "cpp-atomic-no-order-reason", path, first,
+                f"{q}:{order}",
+                f"{count} {order}-ordered atomic op(s) in {q} with no "
+                "'// relaxed-ok:'/'// release-order:' reason annotation "
+                "(same line, the comment block above, or a '(fn):' scope "
+                "marker earlier in the function)",
+            ))
+
+    # ------------------------------------------------------------------
+    # pass 3: cross-file propagation + cycle detection
+    # ------------------------------------------------------------------
+
+    def propagate_and_report(self) -> None:
+        blocking: Dict[str, str] = {}
+        acquires: Dict[str, List[Tuple[str, int]]] = {}
+        for short, defs in self.funcs.items():
+            if len(defs) != 1:
+                continue  # ambiguous short name — skip, conservative
+            f = defs[0]
+            if f.blocks:
+                blocking[short] = f.blocks
+            if f.acquires:
+                acquires[short] = f.acquires
+        for defs in self.funcs.values():
+            for f in defs:
+                for callee, cline, held in f.calls:
+                    if not held or callee == f.qual.split("::")[-1]:
+                        continue
+                    if callee in blocking:
+                        self.findings.append(Finding(
+                            "cpp-blocking-under-lock", f.path, cline,
+                            f"{f.qual}:{callee}()",
+                            f"call to {callee}() (which blocks on "
+                            f"{blocking[callee]}) while holding "
+                            f"{'+'.join(held)}",
+                        ))
+                    for lid, _al in acquires.get(callee, ()):
+                        for h in held:
+                            if h != lid:
+                                self.edges.setdefault(
+                                    (h, lid), (f.path, cline, f.qual)
+                                )
+        self._cycle_rule()
+
+    def _cycle_rule(self) -> None:
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+        color: Dict[str, int] = {}
+        path_stack: List[str] = []
+        cycles: List[List[str]] = []
+
+        def dfs(node: str) -> None:
+            color[node] = 1
+            path_stack.append(node)
+            for m in sorted(adj.get(node, ())):
+                if color.get(m, 0) == 1 and m in path_stack:
+                    cycles.append(path_stack[path_stack.index(m):] + [m])
+                elif color.get(m, 0) == 0:
+                    dfs(m)
+            path_stack.pop()
+            color[node] = 2
+
+        for node in sorted(adj):
+            if color.get(node, 0) == 0:
+                dfs(node)
+        seen: Set[frozenset] = set()
+        for cyc in cycles:
+            key = frozenset(cyc)
+            if key in seen:
+                continue
+            seen.add(key)
+            pairs = [p for p in zip(cyc, cyc[1:]) if p in self.edges]
+            if not pairs:
+                continue
+            where = "; ".join(
+                f"{a}->{b} at {self.edges[(a, b)][0]}:"
+                f"{self.edges[(a, b)][1]} in {self.edges[(a, b)][2]}"
+                for a, b in pairs
+            )
+            path0, line0, _q = self.edges[pairs[0]]
+            self.findings.append(Finding(
+                "cpp-lock-order-cycle", path0, line0, "->".join(cyc),
+                f"lock-order inversion: {' -> '.join(cyc)} ({where}) — "
+                "two threads taking these locks in opposing order "
+                "deadlock",
+            ))
+
+
+def analyze_sources(sources: List[Tuple[str, str]]) -> List[Finding]:
+    """Analyze a set of (repo-relative path, source text) C++ files as
+    one tree (cross-file propagation included)."""
+    an = _Analyzer()
+    stripped = [(p, s, _strip(s)) for p, s in sources]
+    for p, _raw, code in stripped:
+        an.scan_decls(p, code)
+    for p, raw, code in stripped:
+        an.analyze_file(p, raw, code)
+    an.propagate_and_report()
+    seen: Set[Tuple] = set()
+    out: List[Finding] = []
+    for f in sorted(an.findings,
+                    key=lambda f: (f.path, f.line, f.rule, f.symbol)):
+        k = (f.rule, f.path, f.symbol)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+def run(root: Optional[str] = None) -> List[Finding]:
+    """Analyze the native tree (the repo gate)."""
+    root = root or repo_root()
+    sources: List[Tuple[str, str]] = []
+    for pattern in NATIVE_GLOBS:
+        for path in sorted(glob.glob(os.path.join(root, pattern))):
+            rel = os.path.relpath(path, root)
+            with open(path, encoding="utf-8") as f:
+                sources.append((rel, f.read()))
+    return analyze_sources(sources)

@@ -1,0 +1,639 @@
+"""The FT protocol as an executable state machine.
+
+The model is the per-step lifecycle exactly as the implementation ships
+it (``manager.py`` / ``coord.cc`` semantics), abstracted to the decisions
+that carry the correctness argument:
+
+* **Replicas** (one per replica group — the Manager's unit of commit)
+  hold a committed *lineage* — the ordered tuple of per-step commit
+  tokens — plus an error-feedback *residual* version that must track the
+  committed step (PR 6's rollback consistency). A replica is JOINING
+  (pre-first-quorum), HEALTHY, HEALING (behind the round's max step,
+  pulling state from a source), SPECULATING (pipelined commit: the
+  optimizer update applied, the vote still in flight — PR 3), or DEAD.
+* **The lighthouse** forms rounds: replicas join, a round *forms* when
+  the join barrier is satisfied (every live replica — the quorum), and
+  each formed round bumps the epoch (quorum_id). Members compute, vote,
+  and **resolve independently**: the commit vote is arbitrated per
+  replica group (``mgr.should_commit``), not fleet-wide — the only
+  fleet-global wait is the divergence fence's cohort digest compare
+  (PR 10), which blocks resolution until every member's digest (or
+  abstention) is in and vetoes every member's commit on a mismatch.
+* **Crashes** are a first-class action: while the crash budget lasts,
+  any live replica can die *between any two transitions* — the
+  model-checker scheduler interleaves the crash action at every
+  transition point, which is the SIGKILL-anywhere semantics the
+  faultinject runner implements dynamically. Dead replicas respawn from
+  their last committed state (the checkpoint), rejoin behind, and heal.
+
+``SpecConfig`` flags deliberately allow *broken* variants — the fences
+off, the join barrier off (split brain), residual rollback off — so the
+checker can demonstrate that each protection is load-bearing: turning
+one off must produce an invariant violation (the seeded-fixture tests
+assert exactly that), and the shipped configuration must produce none.
+
+Invariants (``check_state`` / ``check_terminal``):
+
+* ``I1 unique-commit``   — at most one committed lineage token per step,
+  fleet-wide (a split brain or silently diverged commit violates this);
+* ``I2 epoch-monotonic`` — a replica's observed quorum epoch never
+  decreases;
+* ``I3 healer-fence``    — a healer never observes (copies) speculative
+  state: heal sources must not be SPECULATING (PR 3's fence);
+* ``I4 residual-rollback`` — every replica's error-feedback residual
+  version equals the step its state actually encodes (committed step, or
+  the provisional step while SPECULATING) — a vetoed speculative update
+  must roll the residual back with the weights (PR 6);
+* ``I5 diverged-commit`` — a *detected* divergence (two member states
+  disagreeing) never commits while the divergence fence is armed
+  (PR 10);
+* ``L  liveness``        — in every terminal state with at least
+  ``min_replicas`` live replicas, some step committed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import FrozenSet, List, NamedTuple, Optional, Tuple
+
+__all__ = [
+    "JOINING", "HEALTHY", "HEALING", "SPECULATING", "DEAD",
+    "SpecConfig", "Replica", "Round", "State", "Invariant",
+    "init_state", "enabled_actions", "check_state", "check_terminal",
+    "is_terminal",
+]
+
+# replica status values (shared vocabulary with the conformance checker
+# and docs/static_analysis.md's state catalog)
+JOINING = "JOINING"
+HEALTHY = "HEALTHY"
+HEALING = "HEALING"
+SPECULATING = "SPECULATING"
+DEAD = "DEAD"
+
+
+@dataclass(frozen=True)
+class SpecConfig:
+    """One bounded configuration of the model.
+
+    The shipped protocol is ``fence_speculation=True``,
+    ``fence_divergence=True`` (sentinel armed), ``join_barrier=True``,
+    ``rollback_residual=True``. Every flag exists so the checker can
+    prove the protection matters by turning it off.
+    """
+
+    n_replicas: int = 2
+    min_replicas: int = 1
+    max_rounds: int = 3          # formed quorum rounds (bounded steps)
+    crash_budget: int = 1        # SIGKILL-anywhere injections
+    respawn_budget: int = 1
+    corrupt_budget: int = 0      # silently-diverging computes
+    speculation: bool = False    # pipelined commit (PR 3 semantics)
+    join_barrier: bool = True    # False = split-brain-capable lighthouse
+    fence_speculation: bool = True   # PR 3: heal waits out speculation
+    fence_divergence: bool = True    # PR 10: mismatched digests veto
+    rollback_residual: bool = True   # PR 6: veto rolls residual back
+
+
+class Replica(NamedTuple):
+    status: str
+    step: int                 # committed step
+    lineage: Tuple[str, ...]  # committed tokens; len == step
+    residual: int             # error-feedback accumulator version
+    joined: bool              # in the lighthouse's open (unformed) round
+    round: int                # formed-round id this replica is in, or -1
+    voted: bool               # voted in `round`
+    abstain: bool             # vote was an abstention (failed heal)
+    worked: bool              # computed this round's reduction
+    diverged: bool            # this round's compute silently corrupted
+    healer: bool              # assigned to heal in `round`
+    healed: bool              # heal transfer landed
+    spec_round: int           # round id of the in-flight speculative vote
+    spec_token: str           # provisional token (speculation)
+    epoch: int                # last quorum epoch observed
+
+
+class Round(NamedTuple):
+    rid: int
+    epoch: int
+    step: int                            # the step this round attempts
+    members: FrozenSet[int]
+    # votes recorded at cast time: (member, token) — token "" = abstain
+    votes: Tuple[Tuple[int, str], ...]
+    resolved: FrozenSet[int]             # members whose vote resolved
+    # members whose collective contribution completed (work done). This
+    # is ROUND state, not replica state: it must survive the member's
+    # later crash — a peer that died AFTER contributing does not fail
+    # the survivors' allreduce, and their commits are per-group.
+    done: FrozenSet[int]
+
+
+class State(NamedTuple):
+    replicas: Tuple[Replica, ...]
+    rounds: Tuple[Round, ...]       # formed rounds, in formation order
+    open_round: FrozenSet[int]      # joined-but-unformed replica ids
+    epoch: int
+    rounds_formed: int
+    crash_budget: int
+    respawn_budget: int
+    corrupt_budget: int
+    # committed tokens per step, fleet-wide: ((step, (tokens...)), ...)
+    commits: Tuple[Tuple[int, Tuple[str, ...]], ...]
+    divergence_latched: bool
+
+
+class Invariant(NamedTuple):
+    """One violated invariant, with human detail."""
+
+    name: str
+    detail: str
+
+
+def init_state(cfg: SpecConfig) -> State:
+    return State(
+        replicas=tuple(
+            Replica(
+                status=JOINING, step=0, lineage=(), residual=0,
+                joined=False, round=-1, voted=False, abstain=False,
+                worked=False, diverged=False, healer=False, healed=False,
+                spec_round=-1, spec_token="", epoch=-1,
+            )
+            for _ in range(cfg.n_replicas)
+        ),
+        rounds=(), open_round=frozenset(), epoch=0, rounds_formed=0,
+        crash_budget=cfg.crash_budget,
+        respawn_budget=cfg.respawn_budget,
+        corrupt_budget=cfg.corrupt_budget,
+        commits=(), divergence_latched=False,
+    )
+
+
+def _token(step: int, diverged: bool, epoch: int) -> str:
+    """A commit token: the identity of the state a replica commits at a
+    step. Epoch-tagged, because one round produces ONE agreed state —
+    two rounds each committing the same step (a split brain) are two
+    lineages even when both computes were clean. Within a round the tag
+    is constant, so the divergence compare keys on the clean/corrupt
+    prefix alone."""
+    return f"{'x' if diverged else 'c'}{step}@e{epoch}"
+
+
+def _commit_record(
+    commits: Tuple[Tuple[int, Tuple[str, ...]], ...], step: int, token: str
+) -> Tuple[Tuple[int, Tuple[str, ...]], ...]:
+    out: List[Tuple[int, Tuple[str, ...]]] = []
+    seen = False
+    for s, toks in commits:
+        if s == step:
+            seen = True
+            if token not in toks:
+                toks = tuple(sorted(toks + (token,)))
+        out.append((s, toks))
+    if not seen:
+        out.append((step, (token,)))
+    return tuple(sorted(out))
+
+
+def _replace(state: State, idx: int, rep: Replica, **kw) -> State:
+    reps = state.replicas[:idx] + (rep,) + state.replicas[idx + 1:]
+    return state._replace(replicas=reps, **kw)
+
+
+def _set_round(state: State, rnd: Round) -> State:
+    return state._replace(rounds=tuple(
+        rnd if rd.rid == rnd.rid else rd for rd in state.rounds
+    ))
+
+
+def _live(state: State) -> List[int]:
+    return [i for i, r in enumerate(state.replicas) if r.status != DEAD]
+
+
+def _provisional_step(r: Replica) -> int:
+    """The step a replica's in-flight state encodes: committed step,
+    plus one while a speculative update is applied."""
+    return r.step + (1 if r.spec_round >= 0 else 0)
+
+
+def _attached(state: State, rnd: Round, j: int) -> bool:
+    r = state.replicas[j]
+    return r.round == rnd.rid or r.spec_round == rnd.rid
+
+
+def enabled_actions(
+    state: State, cfg: SpecConfig
+) -> List[Tuple[str, State]]:
+    """Every transition enabled in ``state``: the scheduler's menu. The
+    crash action appears here like any other, so the DFS interleaves a
+    crash at every transition point — exhaustive SIGKILL-anywhere."""
+    out: List[Tuple[str, State]] = []
+    live = _live(state)
+
+    # -- crash: any live replica, at any point, while the budget lasts
+    if state.crash_budget > 0:
+        for i in live:
+            r = state.replicas[i]
+            # SIGKILL loses everything in memory: the speculative
+            # update, round membership, the un-committed residual
+            # advance. The committed lineage survives (the checkpoint).
+            dead = r._replace(
+                status=DEAD, joined=False, round=-1, voted=False,
+                abstain=False, worked=False, diverged=False,
+                healer=False, healed=False, spec_round=-1,
+                spec_token="", residual=r.step,
+            )
+            ns = _replace(
+                state, i, dead,
+                open_round=state.open_round - {i},
+                crash_budget=state.crash_budget - 1,
+            )
+            out.append((f"crash({i})", ns))
+
+    # -- respawn: a dead replica returns, state = its last commit
+    if state.respawn_budget > 0:
+        for i, r in enumerate(state.replicas):
+            if r.status != DEAD:
+                continue
+            ns = _replace(
+                state, i, r._replace(status=JOINING),
+                respawn_budget=state.respawn_budget - 1,
+            )
+            out.append((f"respawn({i})", ns))
+
+    # -- join: a free live replica enters the lighthouse's open round
+    if state.rounds_formed < cfg.max_rounds:
+        for i in live:
+            r = state.replicas[i]
+            if r.joined or r.round >= 0:
+                continue
+            # pipelined: a replica may join the next round while its
+            # previous vote is still in flight — that IS the pipeline
+            ns = _replace(
+                state, i, r._replace(joined=True),
+                open_round=state.open_round | {i},
+            )
+            out.append((f"join({i})", ns))
+
+    # -- form: the open round becomes a quorum
+    if state.open_round and state.rounds_formed < cfg.max_rounds:
+        joined = state.open_round
+        barrier_ok = (
+            joined == frozenset(live)
+            if cfg.join_barrier
+            else len(joined) >= cfg.min_replicas
+        )
+        if barrier_ok:
+            rid = state.rounds_formed
+            epoch = state.epoch + 1
+            # the round attempts the max provisional step of its
+            # members (the physical step the fleet's trainers are on);
+            # members behind it heal first
+            max_step = max(
+                _provisional_step(state.replicas[i]) for i in joined
+            )
+            reps = list(state.replicas)
+            for i in joined:
+                r = reps[i]
+                behind = _provisional_step(r) < max_step
+                reps[i] = r._replace(
+                    joined=False, round=rid, voted=False, abstain=False,
+                    worked=False, healer=behind, healed=False,
+                    epoch=epoch,
+                    status=(HEALING if behind else (
+                        r.status if r.status == SPECULATING else HEALTHY
+                    )),
+                )
+            ns = state._replace(
+                replicas=tuple(reps),
+                rounds=state.rounds + (
+                    Round(rid=rid, epoch=epoch, step=max_step,
+                          members=joined, votes=(),
+                          resolved=frozenset(), done=frozenset()),
+                ),
+                open_round=frozenset(),
+                epoch=epoch,
+                rounds_formed=rid + 1,
+            )
+            out.append((f"form(r{rid},step={max_step})", ns))
+
+    # per-round member actions
+    for rnd in state.rounds:
+        for i in sorted(rnd.members):
+            if i in rnd.resolved:
+                continue
+            r = state.replicas[i]
+            if r.status == DEAD:
+                continue
+
+            # -- heal: copy state from an up-to-date round member that
+            # has not voted yet (the serve happens at quorum time,
+            # before the source's compute/vote — a voted source's
+            # staged window is closed). The source serves its CURRENT
+            # committed state (manager.py: "the received state dict is
+            # authoritative ... never rewind below the state the bytes
+            # actually encode").
+            if r.round == rnd.rid and r.healer and not r.healed:
+                sourced = False
+                for j in sorted(rnd.members):
+                    src = state.replicas[j]
+                    if (
+                        j == i or src.status == DEAD or src.healer
+                        or not _attached(state, rnd, j)
+                        or (src.round == rnd.rid and src.voted)
+                    ):
+                        continue
+                    speculative = src.spec_round >= 0
+                    if cfg.fence_speculation and speculative:
+                        # PR 3 fence: the heal WAITS until the source's
+                        # vote resolves — the action is disabled, not
+                        # taken (resolve of that vote re-enables it)
+                        continue
+                    sourced = True
+                    lineage = src.lineage
+                    step = src.step
+                    if speculative:
+                        # fence off: the staged state illegally carries
+                        # the un-voted provisional update
+                        lineage = lineage + (src.spec_token,)
+                        step += 1
+                    healed = r._replace(
+                        step=step, lineage=lineage, residual=step,
+                        healed=True, status=HEALING,
+                    )
+                    label = f"heal({i}<-{j})" + (
+                        "!spec" if speculative else ""
+                    )
+                    out.append((label, _replace(state, i, healed)))
+                # -- heal_fail: transfers can fail (torn stream, source
+                # shutdown) and a fenced-out heal eventually times out:
+                # the healer latches the error and its barrier vote
+                # abstains — its own step aborts, nobody else's does
+                if not sourced and not r.voted:
+                    ns = _replace(
+                        state, i,
+                        r._replace(voted=True, abstain=True),
+                    )
+                    ns = _set_round(
+                        ns, rnd._replace(votes=rnd.votes + ((i, ""),))
+                    )
+                    out.append((f"heal_fail({i})", ns))
+
+            # -- work: compute this round's reduction. A replica with a
+            # still-unresolved speculative vote resolves it before
+            # issuing the next step's ops (resolve_pending_commit
+            # precedes collectives), so work is gated on spec_round < 0.
+            ready = (not r.healer) or r.healed
+            if (
+                r.round == rnd.rid and ready and not r.worked
+                and not r.voted and r.spec_round < 0
+            ):
+                with_done = _set_round(
+                    state, rnd._replace(done=rnd.done | {i})
+                )
+                ns = _replace(with_done, i, r._replace(worked=True))
+                out.append((f"work({i})", ns))
+                if state.corrupt_budget > 0 and not r.healer:
+                    ns2 = _replace(
+                        with_done, i,
+                        r._replace(worked=True, diverged=True),
+                        corrupt_budget=state.corrupt_budget - 1,
+                    )
+                    out.append((f"work_corrupt({i})", ns2))
+
+            # -- vote: cast this round's commit vote (with the state
+            # digest riding it — the token). The token's step is the
+            # REPLICA's committed step at vote time (the vote RPC's
+            # rec.step), not the round label: a replica whose previous
+            # speculation was vetoed legitimately re-attempts its
+            # rolled-back step inside a round labeled one ahead
+            # (manager.py start_quorum's "a veto makes that step's
+            # label one ahead" comment).
+            if r.round == rnd.rid and r.worked and not r.voted:
+                token = _token(
+                    r.step, r.diverged and not r.healer, rnd.epoch
+                )
+                if cfg.speculation and not r.healer:
+                    # pipelined: apply the update provisionally, vote,
+                    # and float free to start the next step while the
+                    # vote is in flight
+                    spec = r._replace(
+                        voted=True, status=SPECULATING,
+                        spec_round=rnd.rid, spec_token=token,
+                        residual=r.step + 1,  # error-feedback applied
+                        round=-1,
+                    )
+                    ns = _replace(state, i, spec)
+                    ns = _set_round(
+                        ns, rnd._replace(votes=rnd.votes + ((i, token),))
+                    )
+                    out.append((f"vote_spec({i})", ns))
+                else:
+                    ns = _replace(state, i, r._replace(voted=True))
+                    ns = _set_round(
+                        ns, rnd._replace(votes=rnd.votes + ((i, token),))
+                    )
+                    out.append((f"vote({i})", ns))
+
+            # -- resolve: this replica's vote decision lands. Commit is
+            # arbitrated PER replica group; the divergence fence is the
+            # only fleet-global wait (the cohort digest compare).
+            cast = (
+                (r.round == rnd.rid and r.voted)
+                or r.spec_round == rnd.rid
+            )
+            if cast:
+                unresolved = [
+                    j for j in rnd.members if j not in rnd.resolved
+                ]
+                accounted = all(
+                    (not _attached(state, rnd, j))
+                    or state.replicas[j].status == DEAD
+                    or any(v[0] == j for v in rnd.votes)
+                    for j in unresolved
+                )
+                if cfg.fence_divergence and not accounted:
+                    continue  # fence: wait for the full cohort's digests
+                out.append(_resolve(state, cfg, rnd, i))
+
+    return out
+
+
+def _resolve(
+    state: State, cfg: SpecConfig, rnd: Round, i: int
+) -> Tuple[str, State]:
+    r = state.replicas[i]
+    was_spec = r.spec_round == rnd.rid
+
+    # a member that disappeared BEFORE its collective contribution
+    # landed broke the survivors' allreduce: their ops errored, the
+    # error latched, their steps abort. A member that died after
+    # contributing (work done), cast its vote (incl. a failed-heal
+    # abstention — its ranks still rode the plane with zeros), or
+    # already resolved fails nobody — commits are per-group; the dead
+    # group simply respawns behind and heals.
+    lost = any(
+        j not in rnd.done
+        and j not in rnd.resolved
+        and not any(v[0] == j for v in rnd.votes)
+        and (state.replicas[j].status == DEAD
+             or not _attached(state, rnd, j))
+        for j in rnd.members
+    )
+    # the divergence fence: compare the cast digests within MY (epoch,
+    # step) cohort — the lighthouse keys its compare on (epoch, step),
+    # so votes for a different step never enter it; abstains ("")
+    # complete the cohort but never enter the comparison
+    my_step = state.replicas[i].step
+    tokens = {
+        t for _j, t in rnd.votes
+        if t and t[1:].split("@", 1)[0] == str(my_step)
+    }
+    diverged = len(tokens) > 1
+    latched = state.divergence_latched
+    my_token = r.spec_token if was_spec else next(
+        (t for j, t in rnd.votes if j == i), ""
+    )
+    commit = bool(my_token) and not r.abstain and not lost
+    if diverged and cfg.fence_divergence:
+        commit = False
+        latched = True
+
+    if commit:
+        new_step = r.step + 1
+        lineage = r.lineage + (my_token,)
+        if was_spec:
+            # resolve the speculation in place: the replica may already
+            # be a member of the NEXT round — leave that round's
+            # bookkeeping (round/voted/worked) untouched
+            rep = r._replace(
+                status=(HEALTHY if r.status == SPECULATING else r.status),
+                step=new_step, lineage=lineage, residual=new_step,
+                spec_round=-1, spec_token="",
+            )
+        else:
+            rep = r._replace(
+                status=HEALTHY, step=new_step, lineage=lineage,
+                residual=new_step, round=-1, voted=False, abstain=False,
+                worked=False, diverged=False, healer=False, healed=False,
+            )
+        commits = _commit_record(state.commits, r.step, my_token)
+    else:
+        residual = r.step
+        if was_spec and not cfg.rollback_residual:
+            residual = r.step + 1  # the planted PR 6 bug
+        if was_spec:
+            rep = r._replace(
+                status=(HEALTHY if r.status == SPECULATING else r.status),
+                residual=residual, spec_round=-1, spec_token="",
+            )
+        else:
+            rep = r._replace(
+                status=HEALTHY, round=-1, voted=False, abstain=False,
+                worked=False, diverged=False, healer=False,
+                # an aborted heal is discarded with the step: the healer
+                # stays behind until a committing round
+                healed=False,
+                residual=residual,
+            )
+        commits = state.commits
+
+    ns = _replace(state, i, rep, commits=commits,
+                  divergence_latched=latched)
+    ns = _set_round(ns, rnd._replace(resolved=rnd.resolved | {i}))
+    verdict = "commit" if commit else "abort"
+    return (f"resolve({i},r{rnd.rid},{verdict})", ns)
+
+
+# ---------------------------------------------------------------------------
+# invariants
+# ---------------------------------------------------------------------------
+
+
+def check_state(
+    state: State, cfg: SpecConfig, action: str = ""
+) -> List[Invariant]:
+    """Safety invariants, checked at every visited state."""
+    out: List[Invariant] = []
+
+    # I1: at most one committed lineage per step, fleet-wide
+    for step, tokens in state.commits:
+        if len(tokens) > 1:
+            out.append(Invariant(
+                "I1-unique-commit",
+                f"step {step} committed {len(tokens)} distinct lineages "
+                f"{list(tokens)} — split brain or silently diverged "
+                "commit",
+            ))
+
+    # I3: a heal action that copied speculative state is labeled !spec
+    if action.startswith("heal(") and action.endswith("!spec"):
+        out.append(Invariant(
+            "I3-healer-fence",
+            f"{action}: the healer copied a SPECULATING source's state — "
+            "an un-voted optimizer update leaked into a served "
+            "checkpoint (PR 3 fence violated)",
+        ))
+
+    # I4: residual version == the step the replica's state encodes
+    for i, r in enumerate(state.replicas):
+        if r.status == DEAD:
+            continue
+        expect = _provisional_step(r)
+        if r.residual != expect:
+            out.append(Invariant(
+                "I4-residual-rollback",
+                f"replica {i}: error-feedback residual v{r.residual} but "
+                f"state encodes step {expect} — a vetoed speculative "
+                "update left the residual un-rolled-back (PR 6)",
+            ))
+        if len(r.lineage) != r.step:
+            out.append(Invariant(
+                "I4-residual-rollback",
+                f"replica {i}: lineage length {len(r.lineage)} != "
+                f"committed step {r.step}",
+            ))
+
+    # I5: a DETECTED divergence never commits while the fence is armed.
+    # (A single-member cohort committing a corrupt state is invisible to
+    # any digest compare — the sentinel's contract, like the real one's,
+    # covers disagreement, which needs two states to disagree.)
+    if cfg.fence_divergence:
+        for step, tokens in state.commits:
+            if len(tokens) > 1 and any(t.startswith("x") for t in tokens):
+                out.append(Invariant(
+                    "I5-diverged-commit",
+                    f"step {step} committed disagreeing tokens "
+                    f"{list(tokens)} with the divergence fence armed — "
+                    "the cohort compare must have vetoed this",
+                ))
+
+    # I2: epochs only increment (structural in the model; the
+    # conformance checker enforces it on real trails)
+    for i, r in enumerate(state.replicas):
+        if r.epoch > state.epoch:
+            out.append(Invariant(
+                "I2-epoch-monotonic",
+                f"replica {i} observed epoch {r.epoch} beyond the "
+                f"lighthouse's {state.epoch}",
+            ))
+
+    return out
+
+
+def is_terminal(state: State, cfg: SpecConfig) -> bool:
+    return not enabled_actions(state, cfg)
+
+
+def check_terminal(state: State, cfg: SpecConfig) -> List[Invariant]:
+    """Liveness-ish: a terminal state with a quorum's worth of live
+    replicas must have committed something."""
+    live = _live(state)
+    if len(live) >= cfg.min_replicas and cfg.max_rounds > 0:
+        if not state.commits:
+            return [Invariant(
+                "L-liveness",
+                f"terminal state with {len(live)} live replicas "
+                f"(min_replicas={cfg.min_replicas}) committed nothing "
+                f"in {cfg.max_rounds} rounds",
+            )]
+    return []

@@ -59,6 +59,12 @@ Checks (rule ids):
 ``stub-drift``
     Public names in ``_native/__init__.py`` vs ``_native/__init__.pyi``:
     the typed surface must cover the real one, both directions.
+
+``makefile-hdrs-drift``
+    Every ``native/*.h`` must appear in ``native/Makefile``'s ``HDRS``
+    prerequisite list (and every HDRS entry must exist): a header
+    missing from HDRS means its edits do not rebuild the ``.so`` — the
+    stale-library class that shipped twice (tsdb.h, profiler.h).
 """
 
 from __future__ import annotations
@@ -398,6 +404,48 @@ def check_stub(native_init: str, pyi: str) -> List[Finding]:
     return finds
 
 
+def check_makefile_hdrs(
+    makefile: str, header_names: List[str]
+) -> List[Finding]:
+    """``makefile-hdrs-drift``: every ``native/*.h`` must appear in the
+    Makefile's ``HDRS`` variable — HDRS is the .so targets' prerequisite
+    list, so a header missing from it means editing that header does NOT
+    rebuild the libraries and a stale ``.so`` ships silently. This exact
+    omission happened twice (tsdb.h in PR 11, profiler.h caught again in
+    PR 12); this rule makes it un-shippable. The reverse direction —
+    an HDRS entry whose file is gone — is dead weight that masks the
+    next real omission, so it errors too."""
+    # HDRS := a.h b.h \
+    #         c.h         (continuation lines folded first)
+    folded = re.sub(r"\\\s*\n", " ", makefile)
+    m = re.search(r"^HDRS\s*[:+?]?=\s*(.*)$", folded, re.MULTILINE)
+    listed: Set[str] = set(m.group(1).split()) if m else set()
+    finds: List[Finding] = []
+    if m is None:
+        finds.append(Finding(
+            "makefile-hdrs-drift", "native/Makefile", 0, "HDRS",
+            "no HDRS variable found — the .so targets have no header "
+            "prerequisites at all; every header edit ships a stale .so",
+        ))
+        return finds
+    for name in sorted(header_names):
+        if name not in listed:
+            finds.append(Finding(
+                "makefile-hdrs-drift", "native/Makefile", 0, name,
+                f"native/{name} is not in the Makefile's HDRS — editing "
+                "it will NOT rebuild libtftcore*.so and a stale library "
+                "ships silently (the tsdb.h/profiler.h incident class)",
+            ))
+    for name in sorted(listed):
+        if name not in header_names:
+            finds.append(Finding(
+                "makefile-hdrs-drift", "native/Makefile", 0, name,
+                f"HDRS lists {name} but native/{name} does not exist — "
+                "dead prerequisites mask the next real omission",
+            ))
+    return finds
+
+
 # ---------------------------------------------------------------------------
 # repo gate
 # ---------------------------------------------------------------------------
@@ -456,4 +504,9 @@ def run(root: Optional[str] = None) -> List[Finding]:
     out += check_heal_env(py_fi, heal_doc)
     out += check_fault_sites(native_texts, NATIVE_SITES)
     out += check_stub(native_init, pyi)
+    native_dir = os.path.join(root, "native")
+    headers = sorted(
+        fn for fn in os.listdir(native_dir) if fn.endswith(".h")
+    ) if os.path.isdir(native_dir) else []
+    out += check_makefile_hdrs(_read(root, "native/Makefile"), headers)
     return out

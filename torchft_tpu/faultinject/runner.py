@@ -1792,6 +1792,23 @@ def run_divergence_scenario(
     )
 
 
+def check_conformance(workdir: str) -> Optional[str]:
+    """Spec-conformance replay of a finished scenario's evidence
+    (ISSUE 15): every trail and black box under ``workdir`` is replayed
+    against the executable FT-protocol spec, and any illegal transition
+    FAILS the scenario — every scenario doubles as a conformance proof.
+    Returns the rendered findings (None = conformance-clean)."""
+    try:
+        from torchft_tpu.analysis.protocol import check_tree
+
+        rep = check_tree(workdir)
+    except Exception as e:  # noqa: BLE001 — a broken checker must be loud
+        return f"conformance replay itself failed: {e}"
+    if rep.ok:
+        return None
+    return rep.render()
+
+
 def collect_postmortem(workdir: str, detail: str = "") -> Optional[str]:
     """Auto-forensics on scenario failure: merge the run's black boxes,
     trails and evidence into one postmortem report under the evidence
@@ -2029,6 +2046,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             res = run_scenario(scn, wd, steps=steps, timeout_s=args.timeout,
                                extra_env=extra_env, worker_argv=worker_argv)
+        if res.status == "passed":
+            # conformance gate (ISSUE 15): a scenario that passed its
+            # own assertions must ALSO have produced only protocol-legal
+            # lifecycle transitions — an illegal one fails it from now on
+            conf = check_conformance(wd)
+            if conf is not None:
+                res = Result(
+                    res.scenario, "failed",
+                    f"spec-conformance violation: {conf}",
+                    fired=res.fired, respawns=res.respawns,
+                    checksums=res.checksums,
+                )
         res_s = time.monotonic() - t0
         print(
             f"    {res.status.upper()} in {res_s:.1f}s "

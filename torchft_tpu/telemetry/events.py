@@ -31,7 +31,12 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional
 
-__all__ = ["EventTrail", "read_trail", "CANONICAL_EVENTS"]
+__all__ = [
+    "EventTrail",
+    "read_trail",
+    "CANONICAL_EVENTS",
+    "LIFECYCLE_EVENTS",
+]
 
 ENV_TRAIL_PATH = "TORCHFT_EVENT_TRAIL"
 ENV_TRAIL_MAX_BYTES = "TORCHFT_EVENT_TRAIL_MAX_BYTES"
@@ -71,6 +76,23 @@ CANONICAL_EVENTS = (
     "perf_regression",
     "perf_regression_cleared",
     "diagnosis_captured",
+)
+
+# The protocol-lifecycle subset of the vocabulary: the events the
+# executable FT-protocol spec (torchft_tpu/analysis/protocol/) models and
+# the trace-conformance checker replays. One constant, shared by the
+# emitting side (this trail) and the verifying side (the spec), so the
+# two can never silently disagree about which records ARE the protocol.
+LIFECYCLE_EVENTS = (
+    "quorum_start",
+    "quorum_ready",
+    "heal_begin",
+    "heal_end",
+    "heal_failed",
+    "commit",
+    "abort",
+    "commit_rollback",
+    "divergence_detected",
 )
 
 

@@ -543,6 +543,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="fold diagnosis bundles (bundle.json dirs) into "
                     "the causal timeline; with no DIR, discover them "
                     "under the evidence dir itself")
+    ap.add_argument("--conformance", action="store_true",
+                    help="replay every trail/black box under the "
+                    "evidence dir against the FT-protocol spec "
+                    "(analysis/protocol) and flag illegal transitions; "
+                    "exit 2 on any finding")
     args = ap.parse_args(argv)
 
     if args.perf:
@@ -562,10 +567,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"  [ep={rec.get('ep')} st={rec.get('st')} "
                 f"q={rec.get('q', '-')}] {rec.get('src')}: {rec.get('k')}"
             )
+    conformance_ok = True
+    if args.conformance:
+        # spec replay (ISSUE 15): every recorded lifecycle transition is
+        # checked against the executable protocol spec, so a postmortem
+        # doubles as a conformance proof — an incident whose records are
+        # protocol-legal is an environment/injection story; an illegal
+        # transition is a protocol bug with the exact record named
+        from torchft_tpu.analysis.protocol import check_tree
+
+        conf = check_tree(args.dir)
+        print(conf.render())
+        report["conformance"] = {
+            "sources": conf.sources,
+            "lifecycle_records": conf.lifecycle_records,
+            "findings": [f.__dict__ for f in conf.findings],
+        }
+        conformance_ok = conf.ok
     if args.json_out:
         with open(args.json_out, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=1, default=str)
         print(f"report: {args.json_out}")
+    if not conformance_ok:
+        return 2
     return 0 if report["classification"] in ("clean", "injected") else 2
 
 
