@@ -621,6 +621,7 @@ void Lighthouse::ingest_telemetry(const std::string& replica_id,
   }
   std::string spans = v.gets("spans");
   if (!spans.empty() && spans.size() <= kMaxSpanBytesPerReplica) {
+    telemetry_bytes_spans_ += spans.size();
     t.span_batches.push_back(std::move(spans));
     t.span_bytes += t.span_batches.back().size();
     while (t.span_batches.size() > kMaxBatchesPerReplica ||
@@ -629,6 +630,185 @@ void Lighthouse::ingest_telemetry(const std::string& replica_id,
       t.span_batches.erase(t.span_batches.begin());
     }
   }
+  // Delta-encoded piggybacks (ISSUE 16): a singular blob (the Manager's
+  // direct heartbeat push) or a batch the manager server accumulated
+  // across its local ranks this round. Processed AFTER the legacy
+  // fields so a mixed-mode payload behaves like two reports.
+  if (v.has("tdelta") && v.at("tdelta").type == Value::Type::BYTES)
+    ingest_tdelta(replica_id, v.at("tdelta").s);
+  if (v.has("tdeltas") && v.at("tdeltas").type == Value::Type::LIST)
+    for (const Value& blob : v.at("tdeltas").list)
+      if (blob.type == Value::Type::BYTES)
+        ingest_tdelta(replica_id, blob.s);
+}
+
+void Lighthouse::ingest_tdelta(const std::string& replica_id,
+                               const std::string& blob) {
+  // One incarnation chain per (replica, sender incarnation): a respawn
+  // is a NEW chain by construction (fresh random incarnation), so it
+  // can never inherit the dead pid's interning dictionary or delta
+  // base; the dead chain ages out below while its TSDB ring is
+  // retained (PR 11 dead-ring semantics are per replica_id, untouched).
+  static constexpr size_t kMaxChainsPerReplica = 4;
+  static constexpr size_t kMaxBlobBytes = 1 << 16;
+  if (blob.size() < 11 || blob.size() > kMaxBlobBytes) {
+    telemetry_delta_resyncs_total_++;
+    return;
+  }
+  telemetry_bytes_piggyback_ += blob.size();
+  bool full = ((uint8_t)blob[2] & tftdelta::kFlagFull) != 0;
+  std::string inc = blob.substr(3, 8);
+  auto& chains = delta_states_[replica_id];
+  auto it = chains.find(inc);
+  if (it == chains.end()) {
+    if (!full) {
+      // delta for a chain we do not hold (lighthouse restart, or the
+      // blob beat its own FULL after a respawn): park a resync request
+      // under this incarnation so the next quorum reply asks for FULL
+      auto& st = chains[inc];
+      st.inc = inc;
+      st.resync = true;
+      st.last_ms = now_ms();
+      telemetry_delta_resyncs_total_++;
+      return;
+    }
+    while (chains.size() >= kMaxChainsPerReplica) {
+      auto oldest = chains.begin();
+      for (auto c = chains.begin(); c != chains.end(); ++c)
+        if (c->second.last_ms < oldest->second.last_ms) oldest = c;
+      chains.erase(oldest);
+    }
+  }
+  tftdelta::DecodeState& st = chains[inc];
+  st.last_ms = now_ms();
+  std::string err;
+  std::vector<std::string> changed;
+  if (!tftdelta::apply(st, blob, &err, &changed)) {
+    telemetry_delta_resyncs_total_++;
+    logline("telemetry delta from " + replica_id + "/" +
+            tftdelta::inc_hex(inc) + " rejected (" + err +
+            "); full resync requested");
+    return;
+  }
+  telemetry_delta_blobs_total_++;
+  if (full) telemetry_delta_fulls_total_++;
+  // refresh the legacy row from the decoded flat state so every
+  // downstream surface (/cluster.json, /metrics, straggler detector,
+  // dashboard) is format-blind. Same kMaxReplicas eviction pressure as
+  // the legacy path via the telemetry_ map itself.
+  ReplicaTelemetry& t = telemetry_[replica_id];
+  t.last_ms = now_ms();
+  auto leaf = [&](const char* key) -> const tftdelta::Leaf* {
+    auto f = st.flat.find(key);
+    return f == st.flat.end() ? nullptr : &f->second;
+  };
+  if (const auto* l = leaf("step"))
+    t.step = l->type == tftdelta::kI64 ? l->i : t.step;
+  if (const auto* l = leaf("stuck")) t.stuck = l->b;
+  if (const auto* l = leaf("slo_breach")) t.slo_breach = l->b;
+  if (const auto* l = leaf("last_heal_ts"))
+    t.last_heal_ts = l->type == tftdelta::kF64 ? l->f : (double)l->i;
+  if (const auto* l = leaf("local_step_p50_s"))
+    t.local_step_p50_s = l->type == tftdelta::kF64 ? l->f : (double)l->i;
+  if (const auto* l = leaf("diag_bundles"))
+    t.diag_bundles = l->type == tftdelta::kI64 ? l->i : t.diag_bundles;
+  if (const auto* l = leaf("diag_last"))
+    t.diag_last = l->s.size() <= 256 ? l->s : std::string("(oversized)");
+  if (const auto* l = leaf("diag_dir"))
+    t.diag_dir = l->s.size() <= 512 ? l->s : std::string("(oversized)");
+  t.summary_json = tftdelta::subtree_json(st, "summary");
+  t.anatomy_json = tftdelta::subtree_json(st, "anatomy");
+  // TSDB ingest: under delta, exactly the series whose value MOVED this
+  // blob (an unchanged sample is absent — the ring's consumers cursor
+  // by step, so a skipped flat sample costs nothing). Coordinates ride
+  // the same blob as top-level step/epoch leaves.
+  int64_t epoch = -1, step = -1;
+  if (const auto* l = leaf("epoch"))
+    epoch = l->type == tftdelta::kI64 ? l->i : -1;
+  if (const auto* l = leaf("step"))
+    step = l->type == tftdelta::kI64 ? l->i : -1;
+  static const std::string kSeriesPfx =
+      std::string("series") + tftdelta::kSep;
+  std::map<std::string, double> samples;
+  for (const std::string& key : changed) {
+    if (key.compare(0, kSeriesPfx.size(), kSeriesPfx) != 0) continue;
+    auto f = st.flat.find(key);
+    if (f == st.flat.end()) continue;
+    double val = 0;
+    if (f->second.type == tftdelta::kF64)
+      val = f->second.f;
+    else if (f->second.type == tftdelta::kI64)
+      val = (double)f->second.i;
+    else if (f->second.type == tftdelta::kBool)
+      val = f->second.b ? 1.0 : 0.0;
+    else
+      continue;
+    if (std::isfinite(val)) samples[key.substr(kSeriesPfx.size())] = val;
+  }
+  if (!samples.empty()) tsdb::store().ingest(replica_id, epoch, step, samples);
+  maybe_rollup_fleet();
+}
+
+Value Lighthouse::telemetry_ack(const std::string& replica_id) {
+  Value ack = Value::M();
+  auto it = delta_states_.find(replica_id);
+  if (it == delta_states_.end()) return ack;
+  for (auto& [inc, st] : it->second) {
+    Value a = Value::M();
+    a.set("ver", Value::I((int64_t)st.version));
+    a.set("resync", Value::B(st.resync));
+    ack.set(tftdelta::inc_hex(inc), a);
+  }
+  return ack;
+}
+
+void Lighthouse::maybe_rollup_fleet() {
+  // Fold the fleet's piggybacked wall/local histograms into "_fleet"
+  // pseudo-replica series at a bounded cadence: the fold is O(replicas
+  // x buckets), so running it per-ingest would be O(fleet^2) per round
+  // at 1000 groups. TORCHFT_TELEMETRY_ROLLUP_S (default 1s, 0=off)
+  // bounds it to O(replicas) per second regardless of quorum rate.
+  static const double interval_s = [] {
+    const char* e = getenv("TORCHFT_TELEMETRY_ROLLUP_S");
+    if (!e || !*e) return 1.0;
+    char* end = nullptr;
+    double v = strtod(e, &end);
+    return (end == e || v < 0) ? 1.0 : v;
+  }();
+  if (interval_s <= 0) return;
+  int64_t now = now_ms();
+  if (now - last_fleet_rollup_ms_ < (int64_t)(interval_s * 1000)) return;
+  last_fleet_rollup_ms_ = now;
+  std::map<std::string, tftdelta::HistCounts> fleet;
+  int64_t max_step = -1, max_epoch = -1;
+  for (const auto& [rid, chains] : delta_states_) {
+    (void)rid;
+    for (const auto& [inc, st] : chains) {
+      (void)inc;
+      tftdelta::fold_hists(st, fleet);
+    }
+  }
+  for (const auto& [rid, t] : telemetry_) {
+    (void)rid;
+    max_step = std::max(max_step, t.step);
+  }
+  std::map<std::string, double> samples;
+  for (const char* name : {"wall", "local"}) {
+    auto it = fleet.find(name);
+    if (it == fleet.end()) continue;
+    samples[std::string("fleet.") + name + "_p50_s"] =
+        tftdelta::grid_quantile(it->second, 0.5);
+    samples[std::string("fleet.") + name + "_p99_s"] =
+        tftdelta::grid_quantile(it->second, 0.99);
+  }
+  samples["fleet.groups"] = (double)telemetry_.size();
+  int64_t stuck = 0;
+  for (const auto& [rid, t] : telemetry_) {
+    (void)rid;
+    if (t.stuck) stuck++;
+  }
+  samples["fleet.stuck"] = (double)stuck;
+  tsdb::store().ingest("_fleet", max_epoch, max_step, samples);
 }
 
 Value Lighthouse::handle_evict(const Value& req) {
@@ -742,6 +922,12 @@ Value Lighthouse::handle_quorum(const Value& req, int64_t deadline) {
         if (p.replica_id == requester.replica_id) {
           Value out = Value::M();
           out.set("quorum", it->second.to_value());
+          // telemetry ack (ISSUE 16): per-incarnation delta versions +
+          // resync requests, relayed by the manager server to every
+          // local rank's encoder. Computed here (still under mu_) so
+          // the ack reflects the blobs this very call ingested.
+          Value tack = telemetry_ack(requester.replica_id);
+          if (!tack.map.empty()) out.set("tack", tack);
           return out;
         }
     }
@@ -907,6 +1093,31 @@ std::string Lighthouse::status_html() {
          "<a href=\"/diagnosis.json\">diagnosis.json</a> | "
          "<a href=\"/trace\">merged trace (open in Perfetto)</a></p>";
   }
+  // fleet rollup strip (ISSUE 16): the dashboard reads the same folded
+  // histograms /fleet.json serves, so a 1000-group fleet's health is
+  // one line here instead of a 1000-row table scroll
+  {
+    std::map<std::string, tftdelta::HistCounts> fleet;
+    for (const auto& [rid, chains] : delta_states_) {
+      (void)rid;
+      for (const auto& [inc, st] : chains) {
+        (void)inc;
+        tftdelta::fold_hists(st, fleet);
+      }
+    }
+    auto wit = fleet.find("wall");
+    o << "<h2>Fleet rollup</h2><p>groups reporting: " << telemetry_.size();
+    if (wit != fleet.end()) {
+      char p50[32], p99[32];
+      snprintf(p50, sizeof p50, "%.4f",
+               tftdelta::grid_quantile(wit->second, 0.5));
+      snprintf(p99, sizeof p99, "%.4f",
+               tftdelta::grid_quantile(wit->second, 0.99));
+      o << " | fleet step wall p50: " << p50 << "s p99: " << p99 << "s";
+    }
+    o << " | piggyback bytes: " << telemetry_bytes_piggyback_
+      << " | <a href=\"/fleet.json\">fleet.json</a></p>";
+  }
   o << "<h2>FT events</h2><p>evictions: " << evictions_total_
     << " | data-plane flush re-quorums: " << flush_requests_total_
     << " | divergence incidents: " << divergence_total_ << "</p>";
@@ -924,11 +1135,44 @@ std::string Lighthouse::status_html() {
   return o.str();
 }
 
-std::string Lighthouse::cluster_json() {
+// Minimal query-string split: "a=1&b=2" -> {a:1, b:2} (no %-decoding —
+// every consumer passes plain replica ids / integers).
+static std::map<std::string, std::string> parse_query(
+    const std::string& qs) {
+  std::map<std::string, std::string> out;
+  size_t start = 0;
+  while (start < qs.size()) {
+    size_t amp = qs.find('&', start);
+    std::string kv = qs.substr(
+        start, amp == std::string::npos ? std::string::npos : amp - start);
+    auto eq = kv.find('=');
+    if (eq != std::string::npos)
+      out[kv.substr(0, eq)] = kv.substr(eq + 1);
+    if (amp == std::string::npos) break;
+    start = amp + 1;
+  }
+  return out;
+}
+
+std::string Lighthouse::cluster_json(const std::string& query) {
   // One page answering "which replica stalled, in which state, during
   // which epoch": per-replica last report age, step, heal recency, stuck
   // flag, and the replica's own counters digest (spliced verbatim — it is
   // already a JSON object produced by telemetry.summary()).
+  //
+  // Pagination (ISSUE 16): a 1000-replica fleet's full sweep is several
+  // MB — ?cursor=<replica_id>(exclusive)&limit=<n> windows the replica
+  // map in id order (next_cursor in the reply is the next call's
+  // cursor), and ?since=<ms> filters to replicas whose last report is
+  // at most that old. Parameterless scrapes keep the full legacy shape.
+  auto params = parse_query(query);
+  std::string cursor = params.count("cursor") ? params["cursor"] : "";
+  size_t limit = 0;
+  if (params.count("limit"))
+    limit = (size_t)strtoul(params["limit"].c_str(), nullptr, 10);
+  int64_t since_ms = -1;
+  if (params.count("since"))
+    since_ms = strtoll(params["since"].c_str(), nullptr, 10);
   std::unique_lock<std::mutex> lk(mu_);
   int64_t now = now_ms();  // monotonic: ages only, never absolute times
   std::ostringstream o;
@@ -938,9 +1182,27 @@ std::string Lighthouse::cluster_json() {
     // answers "did any committed step's state ever disagree"
     << ",\"divergence_detected\":"
     << (divergence_detected_ ? "true" : "false")
-    << ",\"divergence_total\":" << divergence_total_ << ",\"replicas\":{";
+    << ",\"divergence_total\":" << divergence_total_
+    << ",\"replica_count\":" << telemetry_.size() << ",\"replicas\":{";
   bool first = true;
-  for (const auto& [id, t] : telemetry_) {
+  std::string next_cursor;
+  bool truncated = false;
+  size_t returned = 0;
+  for (auto mit = cursor.empty() ? telemetry_.begin()
+                                 : telemetry_.upper_bound(cursor);
+       mit != telemetry_.end(); ++mit) {
+    const auto& id = mit->first;
+    const auto& t = mit->second;
+    if (since_ms >= 0 && (now - t.last_ms) > since_ms) continue;
+    if (limit && returned >= limit) {
+      // the cursor is EXCLUSIVE (resume via upper_bound), so it must
+      // name the last id this page returned, not the first one it
+      // didn't — naming the unreturned id would skip it entirely
+      truncated = true;
+      break;
+    }
+    returned++;
+    next_cursor = id;
     if (!first) o << ",";
     first = false;
     // fixed-point: default ostream precision would render real unix
@@ -970,7 +1232,96 @@ std::string Lighthouse::cluster_json() {
       o << "null";
     o << "}";
   }
-  o << "}}";
+  o << "}";
+  if (truncated && !next_cursor.empty())
+    o << ",\"next_cursor\":\"" << json_escape(next_cursor) << "\"";
+  o << "}";
+  return o.str();
+}
+
+std::string Lighthouse::fleet_json(const std::string& query) {
+  // relaxed-ok(fn): telemetry_bytes_scrape_ reads — monotonic stat
+  // counter, no ordering needed
+  // Compact fleet rollup (ISSUE 16): the scrape whose size is
+  // O(#histograms + #phases), NOT O(fleet). Per-replica log2 histograms
+  // ride the delta piggyback as absolute bucket counts; folding them
+  // here is elementwise addition on the shared lathist grid (exact by
+  // construction, PR 8), so fleet percentiles need no per-replica rows.
+  // ?group=<replica_id> adds that one group's own percentile block —
+  // the drill-down path after the fleet view flags an anomaly.
+  auto params = parse_query(query);
+  std::string group = params.count("group") ? params["group"] : "";
+  std::unique_lock<std::mutex> lk(mu_);
+  int64_t now = now_ms();
+  int64_t stuck = 0, breach = 0, min_step = -1, max_step = -1;
+  for (const auto& [id, t] : telemetry_) {
+    (void)id;
+    if (t.stuck) stuck++;
+    if (t.slo_breach) breach++;
+    if (min_step < 0 || t.step < min_step) min_step = t.step;
+    max_step = std::max(max_step, t.step);
+  }
+  std::map<std::string, tftdelta::HistCounts> fleet;
+  size_t delta_replicas = 0;
+  for (const auto& [rid, chains] : delta_states_) {
+    (void)rid;
+    if (!chains.empty()) delta_replicas++;
+    for (const auto& [inc, st] : chains) {
+      (void)inc;
+      tftdelta::fold_hists(st, fleet);
+    }
+  }
+  auto hist_block = [](std::ostringstream& o,
+                       const std::map<std::string, tftdelta::HistCounts>& hs) {
+    bool first = true;
+    o << "{";
+    for (const auto& [name, counts] : hs) {
+      if (!first) o << ",";
+      first = false;
+      char p50[32], p95[32], p99[32];
+      snprintf(p50, sizeof p50, "%.6f", tftdelta::grid_quantile(counts, 0.5));
+      snprintf(p95, sizeof p95, "%.6f", tftdelta::grid_quantile(counts, 0.95));
+      snprintf(p99, sizeof p99, "%.6f", tftdelta::grid_quantile(counts, 0.99));
+      o << "\"" << json_escape(name)
+        << "\":{\"count\":" << tftdelta::hist_total(counts)
+        << ",\"p50_s\":" << p50 << ",\"p95_s\":" << p95 << ",\"p99_s\":"
+        << p99 << "}";
+    }
+    o << "}";
+  };
+  std::ostringstream o;
+  o << "{\"now_unix_ms\":" << wall_ms() << ",\"quorum_id\":"
+    << state_.quorum_id << ",\"groups\":" << telemetry_.size()
+    << ",\"delta_groups\":" << delta_replicas << ",\"stuck\":" << stuck
+    << ",\"slo_breach\":" << breach << ",\"min_step\":" << min_step
+    << ",\"max_step\":" << max_step << ",\"hist\":";
+  hist_block(o, fleet);
+  o << ",\"telemetry\":{\"delta_blobs_total\":"
+    << telemetry_delta_blobs_total_
+    << ",\"delta_fulls_total\":" << telemetry_delta_fulls_total_
+    << ",\"delta_resyncs_total\":" << telemetry_delta_resyncs_total_
+    << ",\"bytes\":{\"piggyback\":" << telemetry_bytes_piggyback_
+    << ",\"spans\":" << telemetry_bytes_spans_ << ",\"scrape\":"
+    << telemetry_bytes_scrape_.load(std::memory_order_relaxed) << "}}";
+  if (!group.empty()) {
+    std::map<std::string, tftdelta::HistCounts> gh;
+    auto git = delta_states_.find(group);
+    if (git != delta_states_.end())
+      for (const auto& [inc, st] : git->second) {
+        (void)inc;
+        tftdelta::fold_hists(st, gh);
+      }
+    o << ",\"group\":{\"id\":\"" << json_escape(group) << "\"";
+    auto tit = telemetry_.find(group);
+    if (tit != telemetry_.end())
+      o << ",\"step\":" << tit->second.step << ",\"stuck\":"
+        << (tit->second.stuck ? "true" : "false") << ",\"last_seen_ms_ago\":"
+        << (now - tit->second.last_ms);
+    o << ",\"hist\":";
+    hist_block(o, gh);
+    o << "}";
+  }
+  o << "}";
   return o.str();
 }
 
@@ -1026,6 +1377,8 @@ std::string Lighthouse::merged_trace_json() {
 
 std::string Lighthouse::handle_http(const std::string& method,
                                     const std::string& path) {
+  // relaxed-ok(fn): telemetry_bytes_scrape_ updates/reads — monotonic
+  // stat counter metering served body bytes, no ordering needed
   if (method == "GET" && path == "/") {
     return http_ok(
         "<!doctype html><html><head><title>torchft_tpu lighthouse</title>"
@@ -1036,10 +1389,26 @@ std::string Lighthouse::handle_http(const std::string& method,
         "t();setInterval(t,1000);</script></body></html>");
   }
   if (method == "GET" && path == "/status") return http_ok(status_html());
-  if (method == "GET" && path == "/cluster.json")
-    return http_ok(cluster_json(), "application/json");
+  // telemetry egress self-metering (ISSUE 16): every scrape channel's
+  // bytes land in torchft_telemetry_bytes_total{channel="scrape"}
+  auto serve_json = [this](const std::string& body) {
+    // relaxed-ok: monotonic stat counter (see coord.h declaration)
+    telemetry_bytes_scrape_.fetch_add(body.size(),
+                                      std::memory_order_relaxed);
+    return http_ok(body, "application/json");
+  };
+  if (method == "GET" && path.rfind("/cluster.json", 0) == 0) {
+    auto qpos = path.find('?');
+    return serve_json(cluster_json(
+        qpos == std::string::npos ? "" : path.substr(qpos + 1)));
+  }
+  if (method == "GET" && path.rfind("/fleet.json", 0) == 0) {
+    auto qpos = path.find('?');
+    return serve_json(fleet_json(
+        qpos == std::string::npos ? "" : path.substr(qpos + 1)));
+  }
   if (method == "GET" && path == "/diagnosis.json")
-    return http_ok(diagnosis_json(), "application/json");
+    return serve_json(diagnosis_json());
   // Range queries over the retained time series (ISSUE 11). Query
   // params: replica=<substr> series=<substr> since=<step, exclusive>
   // max_points=<downsample cap per series>. The `cursor.max_step` in
@@ -1070,13 +1439,20 @@ std::string Lighthouse::handle_http(const std::string& method,
         start = amp + 1;
       }
     }
-    return http_ok(
-        tsdb::store().render_json(replica_f, series_f, since, max_points,
-                                  wall_ms(), json_escape),
-        "application/json");
+    std::string ts_body = tsdb::store().render_json(
+        replica_f, series_f, since, max_points, wall_ms(), json_escape);
+    // relaxed-ok: monotonic stat counter (see coord.h declaration)
+    telemetry_bytes_scrape_.fetch_add(ts_body.size(),
+                                      std::memory_order_relaxed);
+    return http_ok(ts_body, "application/json");
   }
-  if (method == "GET" && path == "/trace")
-    return http_ok(merged_trace_json(), "application/json");
+  if (method == "GET" && path == "/trace") {
+    std::string trace_body = merged_trace_json();
+    // relaxed-ok: monotonic stat counter (see coord.h declaration)
+    telemetry_bytes_scrape_.fetch_add(trace_body.size(),
+                                      std::memory_order_relaxed);
+    return http_ok(trace_body, "application/json");
+  }
   if (method == "GET" && path == "/metrics") {
     // Prometheus text exposition — observability the reference lacks
     // (SURVEY §5.5: "No metrics export"). Scrape-friendly names under a
@@ -1123,6 +1499,26 @@ std::string Lighthouse::handle_http(const std::string& method,
       << "# TYPE torchft_telemetry_oversized_total counter\n"
       << "torchft_telemetry_oversized_total " << telemetry_oversized_total_
       << "\n"
+      // telemetry self-metering (ISSUE 16): bytes by channel plus the
+      // delta-chain health counters — a resync storm (respawn loops, a
+      // lossy reply path) shows up here before it shows up as cost
+      << "# TYPE torchft_telemetry_bytes_total counter\n"
+      << "torchft_telemetry_bytes_total{channel=\"piggyback\"} "
+      << telemetry_bytes_piggyback_ << "\n"
+      << "torchft_telemetry_bytes_total{channel=\"spans\"} "
+      << telemetry_bytes_spans_ << "\n"
+      << "torchft_telemetry_bytes_total{channel=\"scrape\"} "
+      // relaxed-ok: monotonic stat counter (see coord.h declaration)
+      << telemetry_bytes_scrape_.load(std::memory_order_relaxed) << "\n"
+      << "# TYPE torchft_telemetry_delta_blobs_total counter\n"
+      << "torchft_telemetry_delta_blobs_total "
+      << telemetry_delta_blobs_total_ << "\n"
+      << "# TYPE torchft_telemetry_delta_fulls_total counter\n"
+      << "torchft_telemetry_delta_fulls_total "
+      << telemetry_delta_fulls_total_ << "\n"
+      << "# TYPE torchft_telemetry_delta_resyncs_total counter\n"
+      << "torchft_telemetry_delta_resyncs_total "
+      << telemetry_delta_resyncs_total_ << "\n"
       << "# TYPE torchft_tsdb_dropped_series_total counter\n"
       << "torchft_tsdb_dropped_series_total "
       << tsdb::store().dropped_series() << "\n"
@@ -1152,7 +1548,11 @@ std::string Lighthouse::handle_http(const std::string& method,
     // recorded — rpc.serve always; dp.* / quorum.fanout too when the
     // lighthouse shares a process with a worker (in-process tests)
     lathist::render_prometheus(o);
-    return http_ok(o.str(), "text/plain; version=0.0.4");
+    std::string metrics_body = o.str();
+    // relaxed-ok: monotonic stat counter (see coord.h declaration)
+    telemetry_bytes_scrape_.fetch_add(metrics_body.size(),
+                                      std::memory_order_relaxed);
+    return http_ok(metrics_body, "text/plain; version=0.0.4");
   }
   if (method == "GET" && path == "/status.json") {
     std::unique_lock<std::mutex> lk(mu_);
@@ -1367,7 +1767,28 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
       if (!pending_spans_.empty()) pending_spans_ += ",";
       pending_spans_ += spans;
     }
-    pending_telemetry_ = t;
+    if (t.has("tdelta") && t.at("tdelta").type == Value::Type::BYTES) {
+      // Delta blobs (ISSUE 16) accumulate as a LIST — each local rank's
+      // encoder owns a version chain, and last-write-wins would break a
+      // dropped rank's chain into a permanent resync storm. Bounded:
+      // repeated failed rounds degrade by dropping the OLDEST blob
+      // (the chain self-heals via resync) rather than growing forever.
+      const std::string& blob = t.at("tdelta").s;
+      static constexpr size_t kMaxPendingBlobs = 64;
+      static constexpr size_t kMaxPendingBytes = 1 << 19;  // 512 KiB
+      while (!pending_tdeltas_.empty() &&
+             (pending_tdeltas_.size() >= kMaxPendingBlobs ||
+              pending_tdelta_bytes_ + blob.size() > kMaxPendingBytes)) {
+        pending_tdelta_bytes_ -= pending_tdeltas_.front().size();
+        pending_tdeltas_.erase(pending_tdeltas_.begin());
+      }
+      if (blob.size() <= kMaxPendingBytes) {
+        pending_tdelta_bytes_ += blob.size();
+        pending_tdeltas_.push_back(blob);
+      }
+    } else {
+      pending_telemetry_ = t;
+    }
   }
   uint64_t seen = quorum_seq_;
 
@@ -1389,8 +1810,18 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
     pending_plane_.clear();
     Value lreq = Value::M();
     lreq.set("requester", me.to_value());
-    if (!pending_telemetry_.is_none()) {
-      Value t = pending_telemetry_;
+    if (!pending_telemetry_.is_none() || !pending_tdeltas_.empty() ||
+        !pending_spans_.empty()) {
+      Value t = pending_telemetry_.is_none() ? Value::M()
+                                             : pending_telemetry_;
+      if (!pending_tdeltas_.empty()) {
+        Value batch = Value::L();
+        for (auto& blob : pending_tdeltas_)
+          batch.list.push_back(Value::Bytes(std::move(blob)));
+        t.set("tdeltas", batch);
+        pending_tdeltas_.clear();
+        pending_tdelta_bytes_ = 0;
+      }
       if (!pending_spans_.empty()) t.set("spans", Value::S(pending_spans_));
       lreq.set("telemetry", t);
       pending_telemetry_ = Value::None();
@@ -1409,6 +1840,11 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
       // mark recorded: a WireError from the parse below must not make
       // the catch block observe the SAME round trip a second time
       fanout_t0 = -1;
+      // telemetry ack relay (ISSUE 16): every local rank's quorum reply
+      // carries the ack map so each rank's encoder finds its own
+      // incarnation; kept across rounds (a round whose lreq carried no
+      // telemetry still relays the last known versions)
+      if (resp.has("tack")) last_tack_ = resp.at("tack");
       Quorum q = Quorum::from_value(resp.at("quorum"));
       quorums_[++quorum_seq_] = q;
       quorum_error_.reset();
@@ -1459,6 +1895,10 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
   // (a = rank, b = heal) — pairs with the lighthouse's publish records
   bb::record(bb::kQuorumDeliver, res.quorum_id, res.max_step, rank,
              res.heal ? 1 : 0);
+  Value out = res.to_value();
+  // per-rank ack relay (ISSUE 16): read under mu_ (still held here),
+  // BEFORE the injected delay below may drop the lock
+  if (!last_tack_.is_none()) out.set("tack", last_tack_);
   // env-gated injection: hold the computed quorum reply (outside the
   // lock — peer ranks' handlers must not stall behind the injected delay)
   static const long fi_qd =
@@ -1467,7 +1907,7 @@ Value ManagerSrv::handle_quorum(const Value& req, int64_t deadline) {
     lk.unlock();
     fi::sleep_ms(fi_qd);
   }
-  return res.to_value();
+  return out;
 }
 
 Value ManagerSrv::handle_should_commit(const Value& req, int64_t deadline) {

@@ -23,6 +23,7 @@
 #include <vector>
 
 #include "rpc.h"
+#include "telemetry_delta.h"
 #include "wire.h"
 
 namespace tft {
@@ -205,8 +206,19 @@ class Lighthouse {
   void quorum_tick();
   // Must hold mu_. Stores one replica's piggybacked telemetry report.
   void ingest_telemetry(const std::string& replica_id, const Value& v);
+  // Must hold mu_. Applies one delta-encoded piggyback blob (ISSUE 16)
+  // onto the replica's incarnation chain and refreshes the legacy
+  // ReplicaTelemetry row from the decoded flat state.
+  void ingest_tdelta(const std::string& replica_id, const std::string& blob);
+  // Must hold mu_. Per-replica telemetry ack for quorum replies:
+  // {incarnation_hex: {"ver": version, "resync": bool}}.
+  Value telemetry_ack(const std::string& replica_id);
+  // Must hold mu_. Time-gated fold of the fleet histograms into the
+  // TSDB's "_fleet" pseudo-replica (TORCHFT_TELEMETRY_ROLLUP_S cadence).
+  void maybe_rollup_fleet();
   std::string status_html();
-  std::string cluster_json();
+  std::string cluster_json(const std::string& query);
+  std::string fleet_json(const std::string& query);
   std::string diagnosis_json();
   std::string merged_trace_json();
   static std::string http_error_page(const std::string& msg);
@@ -231,6 +243,27 @@ class Lighthouse {
   // Oversized-digest drops across all replicas (loud-degrade counter for
   // the 64 KiB piggyback cap; per-replica counts live in telemetry_).
   int64_t telemetry_oversized_total_ = 0;
+  // Delta-piggyback decode chains (ISSUE 16): replica -> incarnation ->
+  // state. A respawned pid shows up as a NEW incarnation: it gets a
+  // fresh chain (answered with a resync request until its FULL arrives)
+  // while the dead incarnation's chain ages out — it can never inherit
+  // the dead pid's interning dictionary or delta base. Bounded per
+  // replica (kMaxChainsPerReplica in coord.cc) and by kMaxReplicas.
+  std::map<std::string, std::map<std::string, tftdelta::DecodeState>>
+      delta_states_;
+  // Self-metering (ISSUE 16): telemetry bytes by channel. piggyback =
+  // delta blobs ingested, spans = span fragments ingested (both under
+  // mu_); scrape = HTTP bytes served by the telemetry endpoints
+  // (atomic: handle_http composes some replies without mu_).
+  uint64_t telemetry_bytes_piggyback_ = 0;
+  uint64_t telemetry_bytes_spans_ = 0;
+  // relaxed-ok: monotonic stat counter bumped from HTTP serving threads
+  // and read by /metrics scrapes — no ordering needed across channels
+  std::atomic<uint64_t> telemetry_bytes_scrape_{0};
+  uint64_t telemetry_delta_blobs_total_ = 0;
+  uint64_t telemetry_delta_fulls_total_ = 0;
+  uint64_t telemetry_delta_resyncs_total_ = 0;  // rejected/out-of-chain
+  int64_t last_fleet_rollup_ms_ = 0;
   // Divergence sentinel (ISSUE 10): commit-time digest rounds keyed by
   // (epoch, step). Every committed step's post-reduce state is
   // bit-identical across the cohort by construction, so two distinct
@@ -307,6 +340,16 @@ class ManagerSrv {
   // fragments are concatenated across ranks, scalars last-write-wins.
   Value pending_telemetry_;    // NONE when nothing to forward
   std::string pending_spans_;  // accumulated chrome fragments this round
+  // Delta piggyback blobs this round (ISSUE 16): accumulated as a LIST,
+  // never last-write-wins — each local rank's encoder owns a version
+  // chain, and dropping one rank's blob would break its chain into a
+  // permanent resync storm. Bounded (see handle_quorum).
+  std::vector<std::string> pending_tdeltas_;
+  size_t pending_tdelta_bytes_ = 0;
+  // Most recent telemetry ack from the lighthouse's quorum reply,
+  // re-attached to every local rank's mgr.quorum reply so each rank's
+  // encoder sees its own incarnation's ack.
+  Value last_tack_;
   uint64_t quorum_seq_ = 0;
   std::map<uint64_t, Quorum> quorums_;  // seq -> delivered quorum
   std::optional<std::string> quorum_error_;  // lighthouse failure fan-out

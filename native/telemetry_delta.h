@@ -1,0 +1,421 @@
+// Delta-encoded telemetry piggyback decoder + fleet rollup fold (ISSUE 16).
+//
+// The Python side (torchft_tpu/telemetry/fleetdelta.py, the format owner)
+// emits versioned binary blobs: dictionary-interned keys + only-changed
+// leaves since the last acked version, FULL state on a fresh incarnation
+// or a requested resync. This header is the lighthouse's receiving end:
+//
+//   * DecodeState — one incarnation chain's interning dictionary +
+//     current flat {path: leaf} state + version;
+//   * apply()     — parse a blob onto a DecodeState (never throws:
+//     malformed or out-of-chain input returns false and flags resync,
+//     answered via the quorum-reply ack);
+//   * subtree_json() — rebuild the nested JSON object for a path prefix
+//     (the verbatim-splice summary/anatomy strings /cluster.json serves);
+//   * fold_hists()/grid_quantile() — elementwise-exact merge of the
+//     piggybacked log2 histogram buckets across replicas (the grid is
+//     lathist.h's: identical bounds, so the fold is count addition) and
+//     the interpolated percentile read /fleet.json serves.
+//
+// Wire format v1 (see fleetdelta.py for the authoritative layout):
+//   0xD7 | fmt=1 | flags(bit0 FULL) | 8B incarnation | varint version |
+//   varint base_version | varint count | entries
+//   entry: varint keyref=(id<<1)|define [varint len + UTF-8 key] |
+//          type byte (0 DEL, 1 F64 LE, 2 I64 zigzag, 3 BOOL, 4 STR,
+//          5 BYTES) | value
+//
+// Path segments are joined by 0x1f; a 0x1e-prefixed segment is a list
+// index ("\x1e#" = list length) so JSON rebuild emits arrays.
+//
+// Concurrency: everything here is called by the Lighthouse under its
+// mu_; no atomics, no locks of its own.
+#pragma once
+
+#include <array>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "lathist.h"
+
+namespace tftdelta {
+
+namespace lathist = tft::lathist;
+
+constexpr uint8_t kMagic = 0xD7;
+constexpr uint8_t kFmtVersion = 1;
+constexpr uint8_t kFlagFull = 0x01;
+constexpr char kSep = '\x1f';
+constexpr char kIdx = '\x1e';
+constexpr size_t kNumBuckets = lathist::kNumBounds + 1;  // 28
+
+enum LeafType : uint8_t {
+  kDel = 0,
+  kF64 = 1,
+  kI64 = 2,
+  kBool = 3,
+  kStr = 4,
+  kBytes = 5,
+};
+
+struct Leaf {
+  uint8_t type = kF64;
+  double f = 0.0;
+  int64_t i = 0;
+  bool b = false;
+  std::string s;  // STR and BYTES
+};
+
+// One incarnation chain's receiver state. A respawned sender has a new
+// random incarnation, so it can never alias this dictionary or base —
+// the kill/respawn resync guarantee is structural, not best-effort.
+struct DecodeState {
+  std::string inc;               // 8-byte incarnation
+  uint64_t version = 0;          // version of the state held in `flat`
+  std::vector<std::string> keys; // interning dictionary, id-dense
+  std::map<std::string, Leaf> flat;
+  bool resync = false;           // we want a FULL from this sender
+  int64_t last_ms = 0;           // for per-replica chain eviction
+  uint64_t blobs = 0, bytes = 0;
+};
+
+inline bool read_varint(const std::string& b, size_t& off, uint64_t* out) {
+  uint64_t n = 0;
+  int shift = 0;
+  while (off < b.size()) {
+    uint8_t byte = (uint8_t)b[off++];
+    n |= (uint64_t)(byte & 0x7F) << shift;
+    if (!(byte & 0x80)) {
+      *out = n;
+      return true;
+    }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+
+inline int64_t unzigzag(uint64_t n) {
+  return (int64_t)(n >> 1) ^ -(int64_t)(n & 1);
+}
+
+// Apply one blob. Returns true when the state advanced; false leaves the
+// state unchanged (apart from `resync`) and fills `err`. `changed`, when
+// non-null, collects the applied keys (the per-step series samples the
+// TSDB ingests — under delta, exactly the values that moved).
+inline bool apply(DecodeState& st, const std::string& blob, std::string* err,
+                  std::vector<std::string>* changed = nullptr) {
+  auto fail = [&](const char* why) {
+    st.resync = true;
+    if (err) *err = why;
+    return false;
+  };
+  if (blob.size() < 11 || (uint8_t)blob[0] != kMagic)
+    return fail("bad magic");
+  if ((uint8_t)blob[1] != kFmtVersion) return fail("format version skew");
+  bool full = ((uint8_t)blob[2] & kFlagFull) != 0;
+  std::string inc = blob.substr(3, 8);
+  size_t off = 11;
+  uint64_t version = 0, base = 0, count = 0;
+  if (!read_varint(blob, off, &version) || !read_varint(blob, off, &base) ||
+      !read_varint(blob, off, &count))
+    return fail("truncated header");
+  if (!full && (st.inc != inc || st.version != base))
+    return fail("incarnation/base mismatch");
+  // parse into a staging list first: a truncated entry mid-blob must not
+  // leave half a delta applied (the sender's shadow assumes all-or-none)
+  std::vector<std::pair<std::string, Leaf>> staged;
+  std::vector<std::string> new_keys;
+  size_t dict_base = full ? 0 : st.keys.size();
+  for (uint64_t e = 0; e < count; e++) {
+    uint64_t ref = 0;
+    if (!read_varint(blob, off, &ref)) return fail("truncated keyref");
+    std::string key;
+    if (ref & 1) {
+      uint64_t klen = 0;
+      if (!read_varint(blob, off, &klen) || off + klen > blob.size())
+        return fail("truncated key def");
+      key = blob.substr(off, klen);
+      off += klen;
+      if ((ref >> 1) != dict_base + new_keys.size())
+        return fail("non-dense key id");
+      new_keys.push_back(key);
+    } else {
+      uint64_t id = ref >> 1;
+      if (id < dict_base) {
+        key = st.keys[id];
+      } else if (id - dict_base < new_keys.size()) {
+        key = new_keys[id - dict_base];
+      } else {
+        return fail("unknown key id");
+      }
+    }
+    if (off >= blob.size()) return fail("truncated type");
+    uint8_t type = (uint8_t)blob[off++];
+    Leaf leaf;
+    leaf.type = type;
+    switch (type) {
+      case kDel:
+        break;
+      case kF64: {
+        if (off + 8 > blob.size()) return fail("truncated f64");
+        uint64_t bits = 0;
+        memcpy(&bits, blob.data() + off, 8);  // little-endian hosts only
+        double d;
+        memcpy(&d, &bits, 8);
+        leaf.f = d;
+        off += 8;
+        break;
+      }
+      case kI64: {
+        uint64_t zz = 0;
+        if (!read_varint(blob, off, &zz)) return fail("truncated i64");
+        leaf.i = unzigzag(zz);
+        break;
+      }
+      case kBool: {
+        if (off >= blob.size()) return fail("truncated bool");
+        leaf.b = blob[off++] != 0;
+        break;
+      }
+      case kStr:
+      case kBytes: {
+        uint64_t slen = 0;
+        if (!read_varint(blob, off, &slen) || off + slen > blob.size())
+          return fail("truncated string");
+        leaf.s = blob.substr(off, slen);
+        off += slen;
+        break;
+      }
+      default:
+        return fail("unknown leaf type");
+    }
+    staged.emplace_back(std::move(key), std::move(leaf));
+  }
+  // commit
+  if (full) {
+    st.inc = inc;
+    st.keys.clear();
+    st.flat.clear();
+  }
+  for (auto& k : new_keys) st.keys.push_back(std::move(k));
+  for (auto& [key, leaf] : staged) {
+    if (leaf.type == kDel)
+      st.flat.erase(key);
+    else
+      st.flat[key] = std::move(leaf);
+    if (changed) changed->push_back(key);
+  }
+  st.version = version;
+  st.resync = false;
+  st.blobs++;
+  st.bytes += blob.size();
+  return true;
+}
+
+// ------------------------------------------------------- JSON rebuild
+
+inline void json_escape_into(std::ostringstream& o, const std::string& s) {
+  for (unsigned char c : s) {
+    if (c == '\\' || c == '"') {
+      o << '\\' << c;
+    } else if (c < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof buf, "\\u%04x", c);
+      o << buf;
+    } else {
+      o << c;
+    }
+  }
+}
+
+inline void leaf_json(std::ostringstream& o, const Leaf& l) {
+  switch (l.type) {
+    case kF64: {
+      if (!std::isfinite(l.f)) {
+        o << "null";  // JSON has no inf/nan; absence-as-null, never "inf"
+        break;
+      }
+      char buf[40];
+      snprintf(buf, sizeof buf, "%.12g", l.f);
+      o << buf;
+      break;
+    }
+    case kI64:
+      o << l.i;
+      break;
+    case kBool:
+      o << (l.b ? "true" : "false");
+      break;
+    default:  // kStr / kBytes render as (escaped) strings
+      o << '"';
+      json_escape_into(o, l.s);
+      o << '"';
+      break;
+  }
+}
+
+// Path-tree node for rebuilding nested JSON out of the flat state.
+struct TreeNode {
+  const Leaf* leaf = nullptr;
+  std::map<std::string, TreeNode> kids;
+};
+
+inline void tree_json(std::ostringstream& o, const TreeNode& n) {
+  if (n.leaf && n.kids.empty()) {
+    leaf_json(o, *n.leaf);
+    return;
+  }
+  // list detection: any 0x1e-prefixed child segment
+  bool is_list = false;
+  for (const auto& [seg, kid] : n.kids) {
+    (void)kid;
+    if (!seg.empty() && seg[0] == kIdx) {
+      is_list = true;
+      break;
+    }
+  }
+  if (is_list) {
+    long long len = -1;
+    std::map<long long, const TreeNode*> by_idx;
+    for (const auto& [seg, kid] : n.kids) {
+      if (seg.empty() || seg[0] != kIdx) continue;
+      if (seg == std::string(1, kIdx) + "#") {
+        if (kid.leaf && kid.leaf->type == kI64) len = kid.leaf->i;
+        continue;
+      }
+      long long i = strtoll(seg.c_str() + 1, nullptr, 10);
+      by_idx[i] = &kid;
+    }
+    if (len < 0)
+      len = by_idx.empty() ? 0 : by_idx.rbegin()->first + 1;
+    o << '[';
+    for (long long i = 0; i < len; i++) {
+      if (i) o << ',';
+      auto it = by_idx.find(i);
+      if (it == by_idx.end())
+        o << "null";
+      else
+        tree_json(o, *it->second);
+    }
+    o << ']';
+    return;
+  }
+  o << '{';
+  bool first = true;
+  for (const auto& [seg, kid] : n.kids) {
+    if (!first) o << ',';
+    first = false;
+    o << '"';
+    json_escape_into(o, seg);
+    o << "\":";
+    tree_json(o, kid);
+  }
+  o << '}';
+}
+
+// Nested JSON object for every flat key under `prefix` (e.g. "summary");
+// "{}" when the subtree is empty. The rebuilt text is what /cluster.json
+// splices where the legacy path spliced the sender's verbatim JSON.
+inline std::string subtree_json(const DecodeState& st,
+                                const std::string& prefix) {
+  std::string want = prefix + kSep;
+  TreeNode root;
+  bool any = false;
+  for (auto it = st.flat.lower_bound(want); it != st.flat.end(); ++it) {
+    const std::string& key = it->first;
+    if (key.compare(0, want.size(), want) != 0) break;
+    any = true;
+    TreeNode* node = &root;
+    size_t start = want.size();
+    while (true) {
+      size_t sep = key.find(kSep, start);
+      std::string seg = key.substr(
+          start, sep == std::string::npos ? std::string::npos : sep - start);
+      node = &node->kids[seg];
+      if (sep == std::string::npos) break;
+      start = sep + 1;
+    }
+    node->leaf = &it->second;
+  }
+  if (!any) return "{}";
+  std::ostringstream o;
+  tree_json(o, root);
+  return o.str();
+}
+
+// ------------------------------------------------------- fleet rollup
+
+using HistCounts = std::array<uint64_t, kNumBuckets>;
+
+// Fold one chain's piggybacked histogram buckets ("hist\x1f<name>\x1f<i>"
+// leaves, absolute per-bucket counts) into `out[name]`. Elementwise
+// addition on the shared log2 grid — EXACT, the PR 8 merge property.
+inline void fold_hists(const DecodeState& st,
+                       std::map<std::string, HistCounts>& out) {
+  std::string want = std::string("hist") + kSep;
+  for (auto it = st.flat.lower_bound(want); it != st.flat.end(); ++it) {
+    const std::string& key = it->first;
+    if (key.compare(0, want.size(), want) != 0) break;
+    size_t sep = key.rfind(kSep);
+    if (sep == std::string::npos || sep < want.size()) continue;
+    std::string name = key.substr(want.size(), sep - want.size());
+    long idx = strtol(key.c_str() + sep + 1, nullptr, 10);
+    if (idx < 0 || (size_t)idx >= kNumBuckets) continue;
+    int64_t c = 0;
+    if (it->second.type == kI64)
+      c = it->second.i;
+    else if (it->second.type == kF64)
+      c = (int64_t)it->second.f;
+    if (c <= 0) continue;
+    auto& h = out[name];
+    h[(size_t)idx] += (uint64_t)c;
+  }
+}
+
+// Interpolated quantile over folded counts — lathist::quantile's math on
+// a plain array (same grid: bucket i spans (2^(i-21), 2^(i-20)] s).
+inline double grid_quantile(const HistCounts& counts, double q) {
+  uint64_t total = 0;
+  for (uint64_t c : counts) total += c;
+  if (total == 0) return 0.0;
+  double target = q * (double)total;
+  double acc = 0.0;
+  for (size_t i = 0; i < kNumBuckets; i++) {
+    double nxt = acc + (double)counts[i];
+    if (nxt >= target && counts[i]) {
+      double frac = (target - acc) / (double)counts[i];
+      double lo = i == 0 ? 0.0 : lathist::bound_s((int)i - 1);
+      double hi = i < (size_t)lathist::kNumBounds
+                      ? lathist::bound_s((int)i)
+                      : lathist::bound_s(lathist::kNumBounds - 1) * 2.0;
+      return lo + (hi - lo) * frac;
+    }
+    acc = nxt;
+  }
+  return lathist::bound_s(lathist::kNumBounds - 1) * 2.0;
+}
+
+inline uint64_t hist_total(const HistCounts& counts) {
+  uint64_t total = 0;
+  for (uint64_t c : counts) total += c;
+  return total;
+}
+
+inline std::string inc_hex(const std::string& inc) {
+  static const char* hexd = "0123456789abcdef";
+  std::string out;
+  out.reserve(inc.size() * 2);
+  for (unsigned char c : inc) {
+    out.push_back(hexd[c >> 4]);
+    out.push_back(hexd[c & 0xF]);
+  }
+  return out;
+}
+
+}  // namespace tftdelta

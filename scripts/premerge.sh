@@ -13,7 +13,10 @@
 #      now also replays spec-conformance-clean or fails
 #   4. the profiler-overhead smoke  (armed-at-default-Hz vs disarmed
 #      headline leg, gate <=2% — ISSUE 12)
-#   5. the protocol verification gate (ISSUE 15): exhaustive bounded
+#   5. the telemetry-overhead smoke  (piggyback armed vs disarmed
+#      headline leg, gate <=1% / TORCHFT_TELEMETRY_BUDGET_PCT —
+#      ISSUE 16's self-metering budget)
+#   6. the protocol verification gate (ISSUE 15): exhaustive bounded
 #      model check of the quorum/commit spec (crash at every transition
 #      point) + a conformance replay of the quick matrix's trails
 #
@@ -24,10 +27,10 @@
 # "can I even propose this diff" check.
 #
 # Usage:
-#   scripts/premerge.sh              # all five gates
+#   scripts/premerge.sh              # all six gates
 #   scripts/premerge.sh --no-matrix  # skip the faultmatrix (seconds-fast;
-#                                    # gate 5 then skips the replay leg)
-#   scripts/premerge.sh --no-smoke   # skip the profiler-overhead smoke
+#                                    # gate 6 then skips the replay leg)
+#   scripts/premerge.sh --no-smoke   # skip both overhead smokes
 set -u -o pipefail
 
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -46,12 +49,12 @@ done
 rc=0
 fail() { echo "premerge: GATE FAILED: $1" >&2; rc=1; }
 
-echo "=== [1/5] static-analysis gate (python -m torchft_tpu.analysis) ==="
+echo "=== [1/6] static-analysis gate (python -m torchft_tpu.analysis) ==="
 if ! JAX_PLATFORMS=cpu python -m torchft_tpu.analysis; then
   fail "analysis"
 fi
 
-echo "=== [2/5] native strict-warning build (make -C native warn) ==="
+echo "=== [2/6] native strict-warning build (make -C native warn) ==="
 if ! make -C native warn; then
   fail "native warn"
 fi
@@ -70,17 +73,17 @@ fi
 
 MATRIX_DIR="${TMPDIR:-/tmp}/premerge_faultmatrix"
 if [ "$RUN_MATRIX" = 1 ]; then
-  echo "=== [3/5] quick faultmatrix subset (runner --quick) ==="
+  echo "=== [3/6] quick faultmatrix subset (runner --quick) ==="
   if ! JAX_PLATFORMS=cpu python -m torchft_tpu.faultinject.runner --quick \
       --outdir "$MATRIX_DIR"; then
     fail "faultmatrix --quick"
   fi
 else
-  echo "=== [3/5] faultmatrix skipped (--no-matrix) ==="
+  echo "=== [3/6] faultmatrix skipped (--no-matrix) ==="
 fi
 
 if [ "$RUN_SMOKE" = 1 ]; then
-  echo "=== [4/5] profiler-overhead smoke (armed vs disarmed, gate <=2%) ==="
+  echo "=== [4/6] profiler-overhead smoke (armed vs disarmed, gate <=2%) ==="
   # a single short leg on a loaded box can swing past the gate on
   # weather (the row's own note says so) — one breach earns one retry,
   # and only a breach on BOTH runs fails the gate
@@ -93,10 +96,25 @@ if [ "$RUN_SMOKE" = 1 ]; then
     fi
   fi
 else
-  echo "=== [4/5] profiler-overhead smoke skipped (--no-smoke) ==="
+  echo "=== [4/6] profiler-overhead smoke skipped (--no-smoke) ==="
 fi
 
-echo "=== [5/5] protocol verification (model check + conformance replay) ==="
+if [ "$RUN_SMOKE" = 1 ]; then
+  echo "=== [5/6] telemetry-overhead smoke (piggyback armed vs disarmed, gate <=1%) ==="
+  # same weather policy as gate 4: one breach earns one retry
+  if ! JAX_PLATFORMS=cpu python -m torchft_tpu.benchmarks.telemetry_overhead \
+      --smoke; then
+    echo "premerge: smoke breached once — retrying (box weather?)" >&2
+    if ! JAX_PLATFORMS=cpu python -m torchft_tpu.benchmarks.telemetry_overhead \
+        --smoke; then
+      fail "telemetry-overhead smoke (breached twice)"
+    fi
+  fi
+else
+  echo "=== [5/6] telemetry-overhead smoke skipped (--no-smoke) ==="
+fi
+
+echo "=== [6/6] protocol verification (model check + conformance replay) ==="
 PROTO_ARGS=()
 if [ "$RUN_MATRIX" = 1 ] && [ -d "$MATRIX_DIR" ]; then
   PROTO_ARGS+=(--conformance "$MATRIX_DIR")

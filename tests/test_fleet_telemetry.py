@@ -1,0 +1,556 @@
+"""Sublinear fleet telemetry (ISSUE 16): delta-encoded piggybacks,
+mergeable fleet rollups, and the self-metering plane.
+
+Covers the flatten/unflatten path vocabulary, delta round-trips over
+every wire leaf type (float / int / bool / str / bytes / delete) plus
+version skew, empty deltas and type-sensitivity, the byte-cap
+field-by-field degradation (tier-0 latches survive, deferred fields stay
+dirty and ship later), kill/respawn incarnation resync against a live
+lighthouse (a new incarnation never inherits the dead chain; the dead
+TSDB ring is retained), fleet rollup merge exactness vs a Python mirror
+of the native grid-quantile math, /cluster.json cursor pagination +
+``?since=``, the Manager-side self-metering counters, and the ``tack``
+ack loop through a real ManagerServer quorum.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from datetime import timedelta
+from types import SimpleNamespace
+
+import pytest
+
+from torchft_tpu import _native, telemetry
+from torchft_tpu.telemetry.fleetdelta import (
+    IDX,
+    SEP,
+    DeltaDecoder,
+    DeltaEncoder,
+    flatten,
+    poll_fleet,
+    tier_of,
+    unflatten,
+)
+
+
+@pytest.fixture(autouse=True)
+def _delta_on(monkeypatch):
+    # this file tests the delta plane — pin the default-on knob so an
+    # outer TORCHFT_TELEMETRY_DELTA=0 (e.g. a legacy-path suite sweep)
+    # can't silently reroute these tests onto the JSON payload
+    monkeypatch.setenv("TORCHFT_TELEMETRY_DELTA", "1")
+
+
+@pytest.fixture
+def lighthouse():
+    from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+
+    _native.tsdb_reset()
+    lh = LighthouseServer(bind="[::]:0", min_replicas=1)
+    client = LighthouseClient(lh.address(), connect_timeout=timedelta(seconds=5))
+    try:
+        yield lh, client
+    finally:
+        client.close()
+        lh.shutdown()
+        _native.tsdb_reset()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _report(step=1, **extra):
+    base = {"step": step, "epoch": 1, "stuck": False, "slo_breach": False,
+            "local_step_p50_s": 0.1, "last_heal_ts": 0.0}
+    base.update(extra)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# flatten / unflatten — the path vocabulary under the delta format
+# ---------------------------------------------------------------------------
+
+
+class TestFlatten:
+    def test_nested_round_trip_with_lists(self):
+        obj = {
+            "a": {"b": 1, "c": [1.5, "x", True]},
+            "d": "plain",
+            "e": [],  # empty list must survive via the length marker
+        }
+        assert unflatten(flatten(obj)) == obj
+
+    def test_none_leaves_are_skipped(self):
+        flat = flatten({"a": None, "b": 2})
+        assert list(flat) == ["b"]
+
+    def test_list_paths_use_idx_and_length_markers(self):
+        flat = flatten({"l": [7, 8]})
+        assert flat["l" + SEP + IDX + "0"] == 7
+        assert flat["l" + SEP + IDX + "#"] == 2
+
+    def test_huge_int_degrades_to_float(self):
+        flat = flatten({"big": 1 << 80})
+        assert isinstance(flat["big"], float)
+
+    def test_foreign_type_degrades_to_str(self):
+        # tuples flatten as lists; a truly foreign leaf degrades to
+        # str(v) — the legacy json.dumps(default=str) contract
+        flat = flatten({"t": complex(1, 2)})
+        assert flat["t"] == str(complex(1, 2))
+
+    def test_tiers(self):
+        assert tier_of("step") == 0
+        assert tier_of("series" + SEP + "flag.slo_breach") == 0
+        assert tier_of("summary" + SEP + "steps") == 1
+        assert tier_of("series" + SEP + "local_s") == 1
+        assert tier_of("anatomy" + SEP + "p50") == 2
+        assert tier_of("hist" + SEP + "wall" + SEP + "3") == 2
+
+
+# ---------------------------------------------------------------------------
+# delta round-trips (Python encoder <-> Python decoder oracle)
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaRoundTrip:
+    def test_every_leaf_type_round_trips(self):
+        enc, dec = DeltaEncoder(), DeltaDecoder()
+        r = _report(f=1.25, i=-42, b=True, s="héllo", raw=b"\x00\xffbin")
+        out = dec.apply(enc.encode(r))
+        assert out["ok"] and out["full"]
+        assert dec.state() == r
+        # mutate one of each type + delete one key
+        r2 = dict(r, f=2.5, i=43, b=False, s="next", raw=b"\x01")
+        del r2["last_heal_ts"]
+        out = dec.apply(enc.encode(r2))
+        assert out["ok"] and not out["full"]
+        assert dec.state() == r2
+        assert "last_heal_ts" not in dec.flat
+
+    def test_empty_delta_is_tiny_and_changes_nothing(self):
+        enc, dec = DeltaEncoder(), DeltaDecoder()
+        r = _report(summary={"steps": 5})
+        full = enc.encode(r)
+        assert dec.apply(full)["ok"]
+        blob = enc.encode(r)  # identical report → zero entries
+        assert len(blob) < len(full) / 4
+        out = dec.apply(blob)
+        assert out["ok"] and out["changed"] == []
+        assert dec.state() == r
+
+    def test_steady_state_bytes_are_o_changed_not_o_report(self):
+        # 200-key state; one field churns → blob stays flat and small
+        enc, dec = DeltaEncoder(), DeltaDecoder()
+        r = _report(summary={f"c{i}": i for i in range(200)})
+        dec.apply(enc.encode(r))
+        sizes = []
+        for step in range(2, 6):
+            r = dict(r, step=step)
+            blob = enc.encode(r)
+            assert dec.apply(blob)["ok"]
+            sizes.append(len(blob))
+        assert max(sizes) < 40  # header + one interned I64 entry
+        assert len(set(sizes)) == 1  # flat: O(1) steady state
+
+    def test_type_sensitivity_1_vs_1p0_vs_true(self):
+        enc, dec = DeltaEncoder(), DeltaDecoder()
+        dec.apply(enc.encode(_report(v=1)))
+        assert dec.flat["v"] == 1 and type(dec.flat["v"]) is int
+        dec.apply(enc.encode(_report(v=1.0)))
+        assert type(dec.flat["v"]) is float
+        dec.apply(enc.encode(_report(v=True)))
+        assert type(dec.flat["v"]) is bool
+
+    def test_version_skew_requests_resync_and_full_recovers(self):
+        enc, dec = DeltaEncoder(), DeltaDecoder()
+        dec.apply(enc.encode(_report(step=1)))
+        enc.encode(_report(step=2))  # lost on the wire → decoder at v1
+        out = dec.apply(enc.encode(_report(step=3)))
+        assert not out["ok"] and out["resync_wanted"]
+        assert dec.state()["step"] == 1  # stale state untouched
+        # the receiver's tack round-trips resync back to the encoder
+        enc.on_ack({enc.incarnation.hex(): {"ver": dec.version,
+                                            "resync": True}})
+        out = dec.apply(enc.encode(_report(step=4)))
+        assert out["ok"] and out["full"]
+        assert dec.state() == _report(step=4)
+
+    def test_fresh_decoder_rejects_delta_from_unknown_incarnation(self):
+        enc = DeltaEncoder()
+        enc.encode(_report())  # FULL never delivered
+        out = DeltaDecoder().apply(enc.encode(_report(step=2)))
+        assert out["resync_wanted"] and not out["ok"]
+
+    def test_unacked_window_forces_defensive_full(self):
+        enc = DeltaEncoder()
+        enc.encode(_report())
+        fulls_before = enc.fulls_total
+        for step in range(2, 2 + enc.MAX_UNACKED + 2):  # no acks ever
+            enc.encode(_report(step=step))
+        assert enc.fulls_total > fulls_before
+
+    def test_seeded_multi_round_state_equality(self):
+        # deterministic churn over many rounds: decoder state must equal
+        # the sender's report after every single apply
+        enc, dec = DeltaEncoder(), DeltaDecoder()
+        r = _report(summary={}, series={})
+        for step in range(1, 30):
+            r = dict(r, step=step, stuck=bool(step % 3 == 0))
+            r["summary"] = dict(r["summary"], **{f"c{step % 7}": step})
+            r["series"] = {"local_s": step * 0.01}
+            if step % 5 == 0 and f"c{(step - 1) % 7}" in r["summary"]:
+                r["summary"] = dict(r["summary"])
+                del r["summary"][f"c{(step - 1) % 7}"]
+            if step % 11 == 0:
+                enc.on_ack({enc.incarnation.hex(): {"ver": dec.version}})
+            assert dec.apply(enc.encode(r))["ok"]
+            assert dec.state() == r
+
+
+# ---------------------------------------------------------------------------
+# byte-cap degradation: field-by-field, latches first (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestTruncation:
+    FAT = {f"phase_{i}": {"p50": 0.001 * i, "p99": 0.002 * i, "n": i}
+           for i in range(60)}
+
+    def test_tier0_latches_survive_a_tiny_cap(self):
+        enc, dec = DeltaEncoder(max_bytes=256), DeltaDecoder()
+        r = _report(step=7, stuck=True, anatomy=self.FAT)
+        out = dec.apply(enc.encode(r))
+        assert out["ok"]
+        assert enc.last_truncated > 0  # anatomy was deferred, loudly
+        for key in ("step", "epoch", "stuck", "slo_breach",
+                    "local_step_p50_s", "last_heal_ts"):
+            assert key in dec.flat, key
+        assert dec.flat["stuck"] is True
+
+    def test_deferred_fields_ship_on_later_rounds(self):
+        enc, dec = DeltaEncoder(max_bytes=256), DeltaDecoder()
+        r = _report(anatomy=self.FAT)
+        rounds = 0
+        while True:
+            rounds += 1
+            assert rounds < 100
+            assert dec.apply(enc.encode(r))["ok"]
+            if enc.last_truncated == 0:
+                break
+        assert rounds > 1  # the cap actually bit
+        assert dec.state() == r  # ... yet nothing was lost
+        assert enc.truncated_total > 0
+
+
+# ---------------------------------------------------------------------------
+# kill/respawn: new incarnation never inherits the dead chain (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestRespawnResync:
+    def _send(self, client, rid, blob, spans=None):
+        payload = {"tdelta": blob}
+        if spans:
+            payload["spans"] = spans
+        client.heartbeat(rid, telemetry_payload=payload)
+
+    def test_respawn_resyncs_and_dead_tsdb_ring_is_retained(self, lighthouse):
+        lh, client = lighthouse
+        enc1 = DeltaEncoder()
+        for step in range(3):
+            r = _report(step=step, series={"local_s": 0.1 + step * 0.01})
+            self._send(client, "repR", enc1.encode(r))
+        snap = _native.tsdb_snapshot()
+        old_samples = snap["repR"]["local_s"]["samples"]
+        assert [s[1] for s in old_samples] == [0, 1, 2]
+        cl = _get_json(lh.address() + "/cluster.json")
+        assert cl["replicas"]["repR"]["step"] == 2
+
+        # respawn: a NEW encoder = new random incarnation. Its delta
+        # (FULL lost on the wire) must be parked, never applied against
+        # the dead chain's dictionary/base.
+        enc2 = DeltaEncoder()
+        enc2.encode(_report(step=100))  # FULL never delivered
+        fleet0 = poll_fleet(lh.address())
+        self._send(client, "repR",
+                   enc2.encode(_report(step=101,
+                                       series={"local_s": 0.5})))
+        fleet1 = poll_fleet(lh.address())
+        assert (fleet1["telemetry"]["delta_resyncs_total"]
+                > fleet0["telemetry"]["delta_resyncs_total"])
+        cl = _get_json(lh.address() + "/cluster.json")
+        assert cl["replicas"]["repR"]["step"] == 2  # orphan delta dropped
+
+        # the stall-push path: force_full re-bases the new chain
+        enc2.force_full()
+        self._send(client, "repR",
+                   enc2.encode(_report(step=102,
+                                       series={"local_s": 0.6})))
+        cl = _get_json(lh.address() + "/cluster.json")
+        assert cl["replicas"]["repR"]["step"] == 102
+        # dead-ring semantics (PR 11): the replica's TSDB ring is keyed
+        # by replica id, so the first incarnation's samples persist
+        samples = _native.tsdb_snapshot()["repR"]["local_s"]["samples"]
+        steps = [s[1] for s in samples]
+        assert steps[:3] == [0, 1, 2] and steps[-1] == 102
+
+    def test_legacy_and_delta_replicas_coexist(self, lighthouse):
+        lh, client = lighthouse
+        client.heartbeat("legacy", telemetry_payload={
+            "step": 5, "epoch": 1,
+            "summary": json.dumps({"steps": 5}),
+        })
+        enc = DeltaEncoder()
+        self._send(client, "delta",
+                   enc.encode(_report(step=9, summary={"steps": 9})))
+        cl = _get_json(lh.address() + "/cluster.json")
+        assert cl["replicas"]["legacy"]["step"] == 5
+        assert cl["replicas"]["delta"]["step"] == 9
+        assert cl["replicas"]["delta"]["summary"] == {"steps": 9}
+
+
+# ---------------------------------------------------------------------------
+# fleet rollup merge exactness (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _grid_quantile(counts, q):
+    """Python mirror of native/telemetry_delta.h grid_quantile: bucket i
+    spans (2^(i-21), 2^(i-20)] s, overflow interpolates to 2x the last
+    bound."""
+    total = sum(counts)
+    if not total:
+        return 0.0
+    target = q * total
+    acc = 0.0
+    for i, c in enumerate(counts):
+        nxt = acc + c
+        if nxt >= target and c:
+            frac = (target - acc) / c
+            lo = 0.0 if i == 0 else 2.0 ** (i - 21)
+            hi = 2.0 ** (i - 20) if i < 27 else 2.0 ** 7
+            return lo + (hi - lo) * frac
+        acc = nxt
+    return 2.0 ** 7
+
+
+class TestRollupExactness:
+    H_A = {"3": 5, "10": 2}
+    H_B = {"3": 1, "12": 4, "27": 2}  # incl. the overflow slot
+
+    def _fold(self):
+        counts = [0] * 28
+        for h in (self.H_A, self.H_B):
+            for k, v in h.items():
+                counts[int(k)] += v
+        return counts
+
+    def test_fleet_fold_is_exact_sum_and_quantiles_match_oracle(
+        self, lighthouse
+    ):
+        lh, client = lighthouse
+        for rid, h in (("repA", self.H_A), ("repB", self.H_B)):
+            enc = DeltaEncoder()
+            client.heartbeat(rid, telemetry_payload={
+                "tdelta": enc.encode(_report(hist={"wall": h})),
+            })
+        fleet = poll_fleet(lh.address())
+        counts = self._fold()
+        wall = fleet["hist"]["wall"]
+        assert wall["count"] == sum(counts)  # fold is exact by construction
+        for q, key in ((0.5, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s")):
+            want = _grid_quantile(counts, q)
+            assert wall[key] == pytest.approx(want, rel=1e-4, abs=1e-6), key
+
+    def test_group_drilldown_is_that_replicas_own_histogram(self, lighthouse):
+        lh, client = lighthouse
+        for rid, h in (("repA", self.H_A), ("repB", self.H_B)):
+            enc = DeltaEncoder()
+            client.heartbeat(rid, telemetry_payload={
+                "tdelta": enc.encode(_report(hist={"wall": h})),
+            })
+        fleet = poll_fleet(lh.address(), group="repB")
+        assert fleet["group"]["id"] == "repB"
+        assert fleet["group"]["hist"]["wall"]["count"] == sum(
+            self.H_B.values()
+        )
+
+    def test_absolute_bucket_counts_fold_across_delta_rounds(self, lighthouse):
+        # hist buckets ride as ABSOLUTE counts: a later delta replaces,
+        # never double-counts
+        lh, client = lighthouse
+        enc = DeltaEncoder()
+        client.heartbeat("repA", telemetry_payload={
+            "tdelta": enc.encode(_report(step=1, hist={"wall": {"3": 5}})),
+        })
+        client.heartbeat("repA", telemetry_payload={
+            "tdelta": enc.encode(_report(step=2, hist={"wall": {"3": 8}})),
+        })
+        fleet = poll_fleet(lh.address())
+        assert fleet["hist"]["wall"]["count"] == 8
+
+
+# ---------------------------------------------------------------------------
+# /cluster.json cursor pagination + ?since=
+# ---------------------------------------------------------------------------
+
+
+class TestPagination:
+    def test_cursor_walk_covers_the_fleet_without_overlap(self, lighthouse):
+        lh, client = lighthouse
+        ids = [f"rep{c}" for c in "ABCDE"]
+        for i, rid in enumerate(ids):
+            client.heartbeat(rid, telemetry_payload={"step": i, "epoch": 1})
+        seen, pages, cursor = [], 0, ""
+        while True:
+            pages += 1
+            assert pages <= 10
+            url = lh.address() + "/cluster.json?limit=2"
+            if cursor:
+                url += "&cursor=" + cursor
+            page = _get_json(url)
+            seen.extend(page["replicas"])
+            cursor = page.get("next_cursor", "")
+            if not cursor:
+                break
+        assert pages == 3  # 2 + 2 + 1
+        assert sorted(seen) == sorted(ids)
+        assert len(seen) == len(set(seen))  # no overlap
+
+    def test_full_scrape_keeps_legacy_shape(self, lighthouse):
+        lh, client = lighthouse
+        client.heartbeat("repA", telemetry_payload={"step": 1, "epoch": 1})
+        page = _get_json(lh.address() + "/cluster.json")
+        assert "next_cursor" not in page
+        assert page["replica_count"] == 1
+
+    def test_since_filters_stale_replicas(self, lighthouse):
+        lh, client = lighthouse
+        client.heartbeat("old", telemetry_payload={"step": 1, "epoch": 1})
+        time.sleep(0.4)
+        client.heartbeat("fresh", telemetry_payload={"step": 2, "epoch": 1})
+        page = _get_json(lh.address() + "/cluster.json?since=200")
+        assert "fresh" in page["replicas"]
+        assert "old" not in page["replicas"]
+        page = _get_json(lh.address() + "/cluster.json?since=60000")
+        assert sorted(page["replicas"]) == ["fresh", "old"]
+
+
+# ---------------------------------------------------------------------------
+# manager-side self-metering (tentpole part 3)
+# ---------------------------------------------------------------------------
+
+
+def _fake_manager():
+    from torchft_tpu.manager import Manager
+
+    fake = SimpleNamespace(
+        _slo=SimpleNamespace(breached=lambda: False),
+        _watchdog=SimpleNamespace(stalled=False),
+        _step=3,
+        _quorum_id=2,
+        _last_heal_ts=0.0,
+        _divergence_latched=False,
+        _logger=SimpleNamespace(warning=lambda *a, **k: None),
+    )
+    for name in ("_delta_encoder", "_telemetry_report",
+                 "_telemetry_payload_delta", "_telemetry_payload"):
+        setattr(fake, name, getattr(Manager, name).__get__(fake))
+    return fake
+
+
+class TestSelfMetering:
+    def test_payload_is_a_decodable_delta_and_bytes_are_metered(self):
+        fake = _fake_manager()
+        before = telemetry.TELEMETRY_BYTES.labels(channel="piggyback").value
+        payload = fake._telemetry_payload()
+        assert payload is not None and isinstance(payload["tdelta"], bytes)
+        after = telemetry.TELEMETRY_BYTES.labels(channel="piggyback").value
+        assert after - before == len(payload["tdelta"])
+        dec = DeltaDecoder()
+        assert dec.apply(payload["tdelta"])["ok"]
+        state = dec.state()
+        assert state["step"] == 3 and state["epoch"] == 2
+        assert "summary" in state and "hist" in state
+
+    def test_encoder_survives_across_steps_with_one_incarnation(self):
+        fake = _fake_manager()
+        fake._telemetry_payload()
+        inc = fake._tdelta_encoder.incarnation
+        fake._step = 4
+        payload = fake._telemetry_payload()
+        assert fake._tdelta_encoder.incarnation is inc
+        assert payload["tdelta"][3:11] == inc
+        assert not payload["tdelta"][2] & 0x01  # steady state: a delta
+
+    def test_telemetry_is_a_first_class_anatomy_phase(self):
+        from torchft_tpu.telemetry.anatomy import PHASES
+
+        assert "telemetry" in PHASES
+        fake = _fake_manager()
+        fake._telemetry_payload()
+        summary = telemetry.LEDGER.summary()
+        phases = summary.get("phases", summary)
+        assert "telemetry" in str(phases)
+
+    def test_kill_switch_still_wins_over_delta(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_TELEMETRY_PIGGYBACK", "0")
+        assert _fake_manager()._telemetry_payload() is None
+
+
+# ---------------------------------------------------------------------------
+# tack ack loop through a real ManagerServer quorum (tentpole part 1)
+# ---------------------------------------------------------------------------
+
+
+class TestTackLoop:
+    def test_acks_advance_and_deltas_keep_applying(self):
+        from torchft_tpu.coordination import (
+            LighthouseServer,
+            ManagerClient,
+            ManagerServer,
+        )
+
+        _native.tsdb_reset()
+        lh = LighthouseServer(bind="[::]:0", min_replicas=1)
+        mgr = ManagerServer(
+            replica_id="repT", lighthouse_addr=lh.address(),
+            hostname="localhost", bind="[::]:0", store_addr="s",
+            world_size=1,
+        )
+        try:
+            c = ManagerClient(mgr.address(),
+                              connect_timeout=timedelta(seconds=10))
+            enc = DeltaEncoder()
+            acked = []
+            for step in range(3):
+                r = _report(step=step, summary={"steps": step})
+                res = c._quorum(
+                    rank=0, step=step, checkpoint_metadata="m",
+                    shrink_only=False, timeout=timedelta(seconds=10),
+                    telemetry_payload={"tdelta": enc.encode(r)},
+                )
+                ack = res.telemetry_ack
+                assert ack is not None
+                mine = ack[enc.incarnation.hex()]
+                assert not mine.get("resync")
+                acked.append(mine["ver"])
+                enc.on_ack(ack)
+            c.close()
+            assert acked == sorted(acked) and acked[-1] > acked[0]
+            assert enc.acked_version == acked[-1]
+            assert enc.fulls_total == 1  # never re-sent full state
+            cl = _get_json(lh.address() + "/cluster.json")
+            assert cl["replicas"]["repT"]["step"] == 2
+            assert cl["replicas"]["repT"]["summary"] == {"steps": 2}
+        finally:
+            mgr.shutdown()
+            lh.shutdown()
+            _native.tsdb_reset()

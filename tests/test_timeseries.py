@@ -209,7 +209,10 @@ class TestAnatomyOversizeCap:
 
     def test_manager_side_guard_replaces_oversized_digest(self, monkeypatch):
         # the replica end of the same cap: _telemetry_payload must send
-        # an {"_oversized_bytes": n} marker, not the oversize itself
+        # an {"_oversized_bytes": n} marker, not the oversize itself.
+        # Legacy full-JSON path only — the delta encoder (ISSUE 16)
+        # degrades field-by-field instead (tests/test_fleet_telemetry.py)
+        monkeypatch.setenv("TORCHFT_TELEMETRY_DELTA", "0")
         from torchft_tpu.manager import Manager
 
         big = {"rows": ["z" * 1024] * 100}
@@ -222,6 +225,9 @@ class TestAnatomyOversizeCap:
             _last_heal_ts=0.0,
             _divergence_latched=False,
             _logger=SimpleNamespace(warning=lambda *a, **k: None),
+        )
+        fake._telemetry_payload_json = Manager._telemetry_payload_json.__get__(
+            fake
         )
         payload = Manager._telemetry_payload(fake)
         assert payload is not None

@@ -288,7 +288,7 @@ def check_wire_env(
 
 _OBS_RE = re.compile(
     r"TORCHFT_(?:SLO|STRAGGLER|BLACKBOX|DIVERGENCE|TSDB|REGRESSION|PROF"
-    r"|DIAG)_[A-Z0-9_]+"
+    r"|DIAG|TELEMETRY)_[A-Z0-9_]+"
 )
 
 

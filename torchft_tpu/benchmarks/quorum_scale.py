@@ -17,7 +17,16 @@ long-poll, the exact fan-in the lighthouse pays) plus one thread driving
 ``mgr.quorum`` through a real ``ManagerClient``. Everything shares this
 process, so ``_native.lathist_snapshot`` sees every fan-out observation
 and the numbers are pure control-plane cost (no training, no data
-plane).
+plane). Default group counts are ``8,32,64,128,256,512,1024`` — the
+512/1024 points are the ISSUE 16 sublinear-telemetry evidence.
+
+Each N additionally runs two telemetry legs (ISSUE 16): the same
+synthetic per-round report shipped as the legacy full-JSON payload vs
+the delta encoding, with wire bytes per step per replica recorded for
+both, plus /fleet.json scrape p50/p99 against the full /cluster.json
+sweep it replaces. The delta steady-state number is the acceptance
+signal: it must stay ~flat as N grows while the full-JSON leg scales
+with report size.
 
 Caveat recorded in the row: all N servers time-share this host's cores,
 so large N on a small box measures scheduling pressure as well as
@@ -32,18 +41,178 @@ import argparse
 import json
 import threading
 import time
+import urllib.request
 from datetime import timedelta
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 
-def _quorum_round(client, rank: int, step: int, timeout_s: float) -> None:
-    client._quorum(
+def _quorum_round(
+    client,
+    rank: int,
+    step: int,
+    timeout_s: float,
+    telemetry_payload: Optional[Dict] = None,
+):
+    return client._quorum(
         rank=rank,
         step=step,
         checkpoint_metadata="",
         shrink_only=False,
         timeout=timedelta(seconds=timeout_s),
+        telemetry_payload=telemetry_payload,
     )
+
+
+def _synthetic_report(i: int, step: int) -> Dict:
+    """Per-group report with realistic churn: the health scalars move
+    every step, ONE histogram bucket increments, the counters digest
+    bumps a couple of counters. Deterministic (no RNG) so full-JSON and
+    delta legs encode byte-identical logical content."""
+    bucket = 10 + (i % 5)
+    return {
+        "step": step,
+        "epoch": 1,
+        "stuck": False,
+        "slo_breach": False,
+        "local_step_p50_s": 0.1 + (i % 17) * 1e-3,
+        "last_heal_ts": 0.0,
+        "summary": {
+            "quorums": step,
+            "commits": step,
+            "heals_recv": 0,
+            "participants": 1,
+        },
+        "anatomy": {
+            "steps": step,
+            "wall_p50_s": 0.2,
+            "wall_p99_s": 0.3,
+            "local_p50_s": 0.1,
+            "phases": {
+                "compute": {"p50_s": 0.08, "p99_s": 0.1, "total_s": 0.1 * step},
+                "quorum_wait": {
+                    "p50_s": 0.02,
+                    "p99_s": 0.05,
+                    "total_s": 0.02 * step,
+                },
+            },
+        },
+        "hist": {
+            "wall": {str(bucket): step, str(bucket + 1): 1},
+            "local": {str(bucket - 1): step},
+        },
+        "series": {"step_wall_s": 0.2, "step_local_s": 0.1},
+    }
+
+
+def _telemetry_legs(
+    n: int,
+    clients: List,
+    base_step: int,
+    timeout_s: float,
+    lighthouse_addr: str,
+) -> Dict:
+    """ISSUE 16 evidence: the same synthetic per-round report shipped
+    through the legacy full-JSON payload vs the delta encoding, bytes
+    measured with the real wire codec on both legs — plus /fleet.json
+    scrape percentiles and the full /cluster.json sweep they replace."""
+    from torchft_tpu.telemetry.fleetdelta import DeltaEncoder
+    from torchft_tpu.utils.wire import encode as wire_encode
+
+    out: Dict = {}
+    lock = threading.Lock()
+
+    def drive(payload_fn, rounds: int) -> List[int]:
+        """Run `rounds` telemetry-carrying quorum rounds; returns
+        per-round total wire bytes across all n groups."""
+        per_round: List[int] = []
+        for rnd in range(rounds):
+            step = base_step + rnd
+            total = [0]
+            threads = []
+
+            def go(i, c, step=step, total=total):
+                payload = payload_fn(i, step)
+                nbytes = len(wire_encode(payload))
+                try:
+                    r = _quorum_round(c, 0, step, timeout_s, payload)
+                except Exception:  # noqa: BLE001 — counted upstream
+                    return
+                with lock:
+                    total[0] += nbytes
+                ack_fn = getattr(payload_fn, "on_ack", None)
+                if ack_fn is not None and r.telemetry_ack:
+                    ack_fn(i, r.telemetry_ack)
+
+            for i, c in enumerate(clients):
+                th = threading.Thread(target=lambda i=i, c=c: go(i, c))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+            per_round.append(total[0])
+        return per_round
+
+    # --- full-JSON leg (TORCHFT_TELEMETRY_DELTA=0 shape): the whole
+    # report re-serialized and re-sent every round
+    def full_payload(i: int, step: int) -> Dict:
+        rep = _synthetic_report(i, step)
+        return {
+            "summary": json.dumps(rep["summary"], separators=(",", ":")),
+            "anatomy": json.dumps(rep["anatomy"], separators=(",", ":")),
+            "local_step_p50_s": rep["local_step_p50_s"],
+            "slo_breach": rep["slo_breach"],
+            "step": rep["step"],
+            "epoch": rep["epoch"],
+            "stuck": rep["stuck"],
+            "last_heal_ts": rep["last_heal_ts"],
+            "series": rep["series"],
+        }
+
+    full_rounds = drive(full_payload, 2)
+    out["full_bytes_per_step_per_replica"] = round(
+        sum(full_rounds) / (len(full_rounds) * n), 1
+    )
+
+    # --- delta leg: one encoder per group, acks fed back from the
+    # quorum reply; round 0 is the FULL bootstrap, later rounds are the
+    # steady state the 1000-group scaling claim is about
+    encoders = [DeltaEncoder() for _ in range(n)]
+
+    def delta_payload(i: int, step: int) -> Dict:
+        return {"tdelta": encoders[i].encode(_synthetic_report(i, step))}
+
+    delta_payload.on_ack = lambda i, ack: encoders[i].on_ack(ack)
+    delta_rounds = drive(delta_payload, 3)
+    out["delta_first_full_bytes_per_replica"] = round(delta_rounds[0] / n, 1)
+    steady = delta_rounds[1:]
+    out["delta_bytes_per_step_per_replica"] = round(
+        sum(steady) / (len(steady) * n), 1
+    )
+
+    # --- scrape latencies: the O(#hists) rollup vs the O(fleet) sweep
+    def scrape(path: str):
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(
+            f"{lighthouse_addr}{path}", timeout=timeout_s
+        ) as resp:
+            body = resp.read()
+        return time.perf_counter() - t0, len(body)
+
+    fleet_lats: List[float] = []
+    fleet_bytes = 0
+    for _ in range(15):
+        dt, fleet_bytes = scrape("/fleet.json")
+        fleet_lats.append(dt)
+    fleet_lats.sort()
+    out["fleet_scrape_p50_s"] = round(
+        fleet_lats[len(fleet_lats) // 2], 6
+    )
+    out["fleet_scrape_p99_s"] = round(fleet_lats[-1], 6)
+    out["fleet_json_bytes"] = fleet_bytes
+    sweep_s, sweep_bytes = scrape("/cluster.json")
+    out["cluster_sweep_s"] = round(sweep_s, 6)
+    out["cluster_json_bytes"] = sweep_bytes
+    return out
 
 
 def measure_groups(n: int, rounds: int, timeout_s: float) -> Dict:
@@ -112,6 +281,8 @@ def measure_groups(n: int, rounds: int, timeout_s: float) -> Dict:
                 th.join()
         wall_s = time.perf_counter() - t0
 
+        # snapshot BEFORE the telemetry legs so fanout_p50/p99 keep
+        # their original meaning (bare-quorum fan-in cost)
         snap = _native.lathist_snapshot().get("quorum.fanout", {})
         count = int(snap.get("count", 0))
         out = {
@@ -133,6 +304,12 @@ def measure_groups(n: int, rounds: int, timeout_s: float) -> Dict:
                 f"only {count}/{n * rounds} fan-outs recorded "
                 "(client errors or joins folded into one round)"
             )
+        try:
+            out["telemetry"] = _telemetry_legs(
+                n, clients, rounds, timeout_s, lighthouse.address()
+            )
+        except Exception as e:  # noqa: BLE001 — fanout row still lands
+            out["telemetry"] = {"error": str(e)}
         return out
     finally:
         for c in clients:
@@ -175,7 +352,7 @@ def _raise_fd_limit(n: int) -> None:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--groups", default="8,32,64,128,256",
+    ap.add_argument("--groups", default="8,32,64,128,256,512,1024",
                     help="comma-separated group counts")
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--timeout", type=float, default=120.0)

@@ -1,0 +1,142 @@
+"""Telemetry piggyback overhead: armed vs disarmed, the SAME headline FT
+leg, interleaved A/B medians (ISSUE 16 self-metering budget).
+
+The sublinear-telemetry claim has two halves: bytes (quorum_scale's
+full-JSON vs delta legs) and CPU. This row is the CPU half as a measured
+gate: each leg runs the real headline loop (quorum + grads + commit vote
+through the instrumented Manager — the path that builds and delta-encodes
+the piggyback every step) with the telemetry piggyback either armed
+(``TORCHFT_TELEMETRY_PIGGYBACK=1``, the always-on default: report build,
+delta encode, span drain) or disarmed (``=0`` — the kill-switch path that
+skips the whole builder). Legs interleave so both variants see the same
+box drift; medians are compared.
+
+Acceptance: ``overhead_pct <= gate_pct`` where the gate defaults to 1%
+and is tunable via ``TORCHFT_TELEMETRY_BUDGET_PCT``. ``--smoke`` runs a
+reduced config and exits nonzero past the gate — the
+``scripts/premerge.sh`` leg. Where the cost LIVES (encode vs scrape vs
+spans) is a separate question answered by
+``tft_telemetry_bytes_total{channel}`` and the ``telemetry`` anatomy
+phase; this row only guards the total.
+
+Prints one JSON object on the last stdout line (the
+``_run_json_subprocess`` contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def gate_pct() -> float:
+    """Budget gate: telemetry may cost at most this % of step rate."""
+    try:
+        return float(os.environ.get("TORCHFT_TELEMETRY_BUDGET_PCT", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+def measure(
+    runs: int, steps: int, warmup: int, batch: int, seq: int
+) -> dict:
+    # import inside: bench.py's subprocess contract, and the headline
+    # model config must come from bench.py so the two rows can never
+    # silently diverge
+    sys.path.insert(
+        0,
+        os.path.normpath(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "..")
+        ),
+    )
+    from bench import headline_config, train_bench
+
+    from torchft_tpu import telemetry
+
+    cfg = headline_config()
+    armed: list = []
+    disarmed: list = []
+
+    def set_armed(on: bool) -> None:
+        # the kill switch is read per-call in Manager._telemetry_payload,
+        # so an env flip takes effect on the next step
+        os.environ["TORCHFT_TELEMETRY_PIGGYBACK"] = "1" if on else "0"
+
+    # one throwaway leg first: jit compilation must not land inside
+    # either variant's timed window
+    set_armed(False)
+    train_bench(cfg, batch, seq, 1, 1, averaging=True)
+
+    for _ in range(runs):  # interleaved: both variants see the same drift
+        set_armed(True)
+        armed.append(train_bench(cfg, batch, seq, steps, warmup,
+                                 averaging=True)[0])
+        set_armed(False)
+        disarmed.append(train_bench(cfg, batch, seq, steps, warmup,
+                                    averaging=True)[0])
+    set_armed(True)  # leave the process in the always-on default
+
+    piggyback_bytes = telemetry.TELEMETRY_BYTES.labels(
+        channel="piggyback"
+    ).value
+    span_bytes = telemetry.TELEMETRY_BYTES.labels(channel="spans").value
+
+    armed.sort()
+    disarmed.sort()
+    a = armed[len(armed) // 2]
+    d = disarmed[len(disarmed) // 2]
+    overhead = (d - a) / d * 100.0 if d else 0.0
+    gate = gate_pct()
+    return {
+        "_gate_presence": True,
+        "steps_per_sec": round(a, 4),
+        "steps_per_sec_disarmed": round(d, 4),
+        "overhead_pct": round(overhead, 2),
+        "gate_pct": gate,
+        "within_gate": overhead <= gate,
+        "piggyback_bytes": int(piggyback_bytes),
+        "span_bytes": int(span_bytes),
+        "runs_armed": [round(r, 4) for r in armed],
+        "runs_disarmed": [round(r, 4) for r in disarmed],
+        "config": {"batch": batch, "seq": seq, "steps": steps,
+                   "warmup": warmup, "runs": runs},
+        "note": "headline FT leg with the telemetry piggyback armed vs "
+        "disarmed, interleaved medians; the self-metering budget gate "
+        "(<=1% default, TORCHFT_TELEMETRY_BUDGET_PCT). Single-run "
+        "medians on a loaded 1-core box can swing past the gate on "
+        "weather — re-run before believing a breach.",
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="reduced premerge leg: tiny batch/seq, exit 1 past the gate",
+    )
+    args = ap.parse_args()
+
+    if args.smoke:
+        batch, seq, steps = 2, 64, args.steps or 3
+    else:
+        batch, seq, steps = 4, 128, args.steps or 5
+
+    row = measure(args.runs, steps, args.warmup, batch, seq)
+    print(json.dumps({"telemetry_overhead": row}))
+    if args.smoke and not row["within_gate"]:
+        print(
+            f"telemetry overhead {row['overhead_pct']}% exceeds the "
+            f"{row['gate_pct']}% gate",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

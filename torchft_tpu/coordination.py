@@ -56,6 +56,12 @@ class QuorumResult:
     # someone heals this round — every up-to-date member stages a
     # checkpoint so all of them can serve stripes
     heal_pending: bool = False
+    # telemetry-delta ack (ISSUE 16): lighthouse's last-applied delta
+    # version per encoder incarnation, {inc_hex: {"ver": int, "resync":
+    # bool}}. The manager feeds it to its DeltaEncoder so steady-state
+    # piggybacks stay O(changed fields); None when the lighthouse has
+    # not acked anything yet (or telemetry is off)
+    telemetry_ack: Optional[Dict[str, Any]] = None
 
     @staticmethod
     def _from_wire(d: Dict[str, Any]) -> "QuorumResult":
@@ -81,6 +87,7 @@ class QuorumResult:
                 for s in d.get("recover_src_addresses", [])
             ],
             heal_pending=d.get("heal_pending", False),
+            telemetry_ack=d.get("tack") or None,
         )
 
 

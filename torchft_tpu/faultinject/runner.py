@@ -776,21 +776,25 @@ def run_straggler_scenario(
 ) -> Result:
     """The straggler_group scenario (ISSUE 8 satellite): two legs.
 
-    **Injected leg** — group 1 submits every allreduce 200 ms late (the
-    ``collective.issue`` delay site). The runner hosts the fleet
-    detector: a :class:`~torchft_tpu.telemetry.slo.FleetMonitor` polls
-    the lighthouse's ``/cluster.json`` for the piggybacked local-step
-    p50s and feeds a :class:`StragglerDetector` (factor 2.0, K=3 — tight
+    **Control leg** (runs first) — the soak with no injection; the
+    detector must produce ZERO events (the false-positive gate the
+    ROADMAP elastic-fleet item needs before staleness-bounded async
+    commits can trust the signal). Its final per-replica local-step
+    p50s also size the injected leg's skew: the factor-2.0 gate is on
+    the *ratio* to the fleet median, so the skew must scale with
+    whatever the host's steady step time happens to be that run.
+
+    **Injected leg** — group 1 submits every allreduce ``2x`` the
+    measured steady p50 late (floor 200 ms; the ``collective.issue``
+    delay site). The runner hosts the fleet detector: a
+    :class:`~torchft_tpu.telemetry.slo.FleetMonitor` polls the
+    lighthouse's ``/cluster.json`` for the piggybacked local-step p50s
+    and feeds a :class:`StragglerDetector` (factor 2.0, K=3 — tight
     enough to latch within the 16-step run, wide enough that scheduler
     jitter between two identical groups can't reach it). Asserts: the
     detector names exactly ``train_bytes_1``, emits exactly ONE latched
     ``straggler_detected`` event, and the final checksums are finite and
     bit-identical across groups (a delay must never corrupt averages).
-
-    **Control leg** — the identical soak with no injection; the same
-    detector configuration must produce ZERO events (the false-positive
-    gate the ROADMAP elastic-fleet item needs before staleness-bounded
-    async commits can trust the signal).
     """
     from torchft_tpu.coordination import LighthouseServer
     from torchft_tpu.telemetry.slo import FleetMonitor, StragglerDetector
@@ -798,8 +802,11 @@ def run_straggler_scenario(
     victim_id = "train_bytes_1"
     detector_cfg = dict(factor=2.0, k=3)
 
-    def leg(name: str, inject: bool) -> "tuple[Optional[str], List[Dict], int]":
-        """Run one 2-group soak; returns (error, detector_events, fired)."""
+    def leg(
+        name: str, inject: bool, delay_ms: Optional[int] = None
+    ) -> "tuple[Optional[str], List[Dict], int, Dict[str, float]]":
+        """Run one 2-group soak; returns (error, detector_events, fired,
+        final per-replica local-step p50s)."""
         wd = os.path.join(workdir, name)
         os.makedirs(wd, exist_ok=True)
         evidence_dir = os.path.join(wd, "evidence")
@@ -818,12 +825,19 @@ def run_straggler_scenario(
         env1 = _worker_env(scn, 1)
         if not inject:
             env1.pop("TORCHFT_FAULT_SCHEDULE", None)
+        elif delay_ms is not None:
+            # weather-sized skew (see the leg ordering below): patch the
+            # schedule's delay in place of the spec's floor value
+            sched = json.loads(env1["TORCHFT_FAULT_SCHEDULE"])
+            sched["rules"][0]["ms"] = int(delay_ms)
+            env1["TORCHFT_FAULT_SCHEDULE"] = json.dumps(sched)
         procs = {
             0: _spawn(0, addr, wd, steps, env0),
             1: _spawn(1, addr, wd, steps, env1),
         }
         deadline = time.monotonic() + timeout_s
         err: Optional[str] = None
+        p50s: Dict[str, float] = {}
         try:
             while True:
                 # the runner IS the fleet monitor: poll synchronously so
@@ -846,6 +860,21 @@ def run_straggler_scenario(
                     err = f"{name}: timeout after {timeout_s}s"
                     break
                 time.sleep(0.25)
+            # final per-replica p50s: the control leg's steady step time
+            # is what sizes the injected leg's skew
+            try:
+                from torchft_tpu.telemetry.native import poll_cluster
+
+                cluster = poll_cluster(lighthouse.address()) or {}
+                for rid, rec in (cluster.get("replicas") or {}).items():
+                    try:
+                        p50s[rid] = float(
+                            rec.get("local_step_p50_s") or 0.0
+                        )
+                    except (TypeError, ValueError):
+                        pass
+            except Exception:  # noqa: BLE001 — best effort
+                pass
         finally:
             for p in procs.values():
                 if p.poll() is None:
@@ -855,9 +884,34 @@ def run_straggler_scenario(
             cs_err, _sums = _final_checksums(wd)
             if cs_err:
                 err = f"{name}: {cs_err}"
-        return err, events, len(read_evidence(evidence_dir))
+        return err, events, len(read_evidence(evidence_dir)), p50s
 
-    err, events, fired = leg("injected", inject=True)
+    # Control leg FIRST: beyond the false-positive gate, it measures the
+    # box's steady local-step p50 so the injected skew can be sized
+    # RELATIVE to it. The factor-2.0 detector needs p50+skew >= 2x the
+    # fleet median — a fixed 200 ms skew that dwarfs an idle box's
+    # ~0.15 s steps never crosses the gate on a loaded box running
+    # ~0.5 s steps (found as a full-suite-only flake: the detector
+    # mathematically could not latch under that day's load).
+    ctl_err, ctl_events, _cf, ctl_p50s = leg("control", inject=False)
+    if ctl_err:
+        return Result(scn.name, "failed", ctl_err)
+    if ctl_events:
+        return Result(
+            scn.name, "failed",
+            f"control soak emitted detector events (false positives): "
+            f"{ctl_events}",
+        )
+    steady = sorted(v for v in ctl_p50s.values() if v > 0)
+    delay_ms = 200
+    if steady:
+        # 2x the steady p50 puts the victim's p50 at ~3x the fleet
+        # median — comfortably past factor 2.0, while two identical
+        # groups' jitter stays far below it
+        delay_ms = max(200, int(2000 * steady[len(steady) // 2]))
+
+    err, events, fired, _p50s = leg("injected", inject=True,
+                                    delay_ms=delay_ms)
     if err:
         return Result(scn.name, "failed", err, fired=fired)
     detected = [e for e in events if e["event"] == "straggler_detected"]
@@ -881,19 +935,11 @@ def run_straggler_scenario(
             "no injection evidence recorded — the delay never fired",
         )
 
-    ctl_err, ctl_events, _ = leg("control", inject=False)
-    if ctl_err:
-        return Result(scn.name, "failed", ctl_err, fired=fired)
-    if ctl_events:
-        return Result(
-            scn.name, "failed",
-            f"control soak emitted detector events (false positives): "
-            f"{ctl_events}", fired=fired,
-        )
     return Result(
         scn.name, "passed",
         f"latched {victim_id} once (p50 {detected[0]['p50_s']}s vs "
-        f"baseline {detected[0]['baseline_s']}s); control soak clean",
+        f"baseline {detected[0]['baseline_s']}s, {delay_ms}ms skew); "
+        f"control soak clean",
         fired=fired,
     )
 
@@ -1157,8 +1203,10 @@ def run_perf_regression_scenario(
     (the false-positive gate).
 
     **Injected leg** — identical soak, but group 1 submits every
-    allreduce 150 ms late FROM the onset occurrence onward (the `after`
-    rule — a level shift, not a transient). Asserts: (a) the sentinel
+    allreduce late FROM the onset occurrence onward (the `after` rule —
+    a level shift, not a transient), the shift sized at ~1x the control
+    leg's measured median step wall (floor 150 ms) so the
+    relative-threshold sentinel sees a doubling at any host load. Asserts: (a) the sentinel
     latches at least one series, every latch names the injected group,
     and each (replica, series) latches exactly once; (b) the first latch
     lands within K=10 commits of the measured onset step; (c) post-onset
@@ -1188,11 +1236,11 @@ def run_perf_regression_scenario(
     K_COMMITS = 10
     # slightly conservative vs the defaults: this box runs 2 jax workers
     # on few cores, so per-step jitter is real — a wider drift allowance
-    # keeps the control leg honest while the +150ms shift (≈2x the
-    # typical local step here) still latches within a handful of samples
+    # keeps the control leg honest while the ~1x-median shift still
+    # latches within a handful of samples
     det_cfg = dict(delta=0.1, lam=4.0, min_n=8, k=4)
 
-    def leg(name: str, inject: bool):
+    def leg(name: str, inject: bool, delay_ms: Optional[int] = None):
         """One monitored 2-group soak. Returns (err, reg_events,
         attributions, fired, onset_ts, workdir)."""
         wd = os.path.join(workdir, name)
@@ -1226,6 +1274,15 @@ def run_perf_regression_scenario(
         env1 = _worker_env(scn, 1)
         if not inject:
             env1.pop("TORCHFT_FAULT_SCHEDULE", None)
+        elif delay_ms is not None:
+            # the level shift is sized off the control leg's measured
+            # steady wall (see the call sites): PH's lambda/delta are
+            # RELATIVE to the running location, so a fixed 150 ms shift
+            # that latches instantly on idle ~0.08 s steps is invisible
+            # on a loaded box running ~0.5 s steps
+            sched = json.loads(env1["TORCHFT_FAULT_SCHEDULE"])
+            sched["rules"][0]["ms"] = int(delay_ms)
+            env1["TORCHFT_FAULT_SCHEDULE"] = json.dumps(sched)
         procs = {
             0: _spawn(0, addr, wd, leg_steps, env0),
             1: _spawn(1, addr, wd, leg_steps, env1),
@@ -1327,9 +1384,23 @@ def run_perf_regression_scenario(
             "control leg produced no critical-path attributions (no "
             "per-step series reached the lighthouse?)",
         )
+    # size the injected shift off the measured steady wall (post-warm-up
+    # commits only — the first ~8 steps are jit compiles): 1x the median
+    # step time is a doubling, which the relative-lambda PH latches in a
+    # handful of samples at ANY load level, where the spec's fixed
+    # 150 ms floor only clears the gate on an idle box
+    ctl_walls = sorted(
+        a["wall_s"] for a in ctl_atts
+        if a.get("wall_s") and a.get("step") is not None and a["step"] >= 8
+    )
+    delay_ms = 150
+    if ctl_walls:
+        delay_ms = max(150, int(1000 * ctl_walls[len(ctl_walls) // 2]))
 
     # ---- injected leg -------------------------------------------------
-    err, events, atts, fired, onset_ts, wd = leg("injected", inject=True)
+    err, events, atts, fired, onset_ts, wd = leg(
+        "injected", inject=True, delay_ms=delay_ms
+    )
     if err:
         return Result(scn.name, "failed", err, fired=fired)
     if fired == 0:

@@ -519,6 +519,12 @@ class Manager:
             try:
                 from torchft_tpu.coordination import LighthouseClient
 
+                # out-of-band push: always the self-contained legacy
+                # JSON row, never the delta encoder — this thread racing
+                # the quorum path's encode (or its heartbeat arriving
+                # out of order) would break the version chain; a JSON
+                # row lands regardless of the chain's state, even after
+                # a lighthouse restart (the ingest is format-blind)
                 client = LighthouseClient(
                     self._lighthouse_addr, connect_timeout=timedelta(seconds=5)
                 )
@@ -526,7 +532,7 @@ class Manager:
                     client.heartbeat(
                         self._replica_id,
                         timeout=timedelta(seconds=5),
-                        telemetry_payload=self._telemetry_payload(),
+                        telemetry_payload=self._telemetry_payload_json(),
                     )
                 finally:
                     client.close()
@@ -544,12 +550,118 @@ class Manager:
         event they belong to."""
         return f"{self._replica_id}:{self._step_label}:{self._quorum_id}"
 
+    def _delta_encoder(self):
+        """Lazy per-manager DeltaEncoder (ISSUE 16). One encoder per
+        Manager lifetime: its random incarnation is what lets the
+        lighthouse tell a respawned replica from a delta-chain
+        continuation, so it must NOT be recreated across steps."""
+        enc = getattr(self, "_tdelta_encoder", None)
+        if enc is None:
+            from torchft_tpu.telemetry.fleetdelta import DeltaEncoder
+
+            enc = DeltaEncoder()
+            self._tdelta_encoder = enc
+        return enc
+
+    def _telemetry_report(self) -> Dict[str, Any]:
+        """The nested per-replica report the delta encoder flattens:
+        health scalars + counters digest + anatomy + mergeable log2
+        histograms + time-series samples. Keys here ARE the wire
+        vocabulary the lighthouse rebuilds /cluster.json fields from."""
+        from torchft_tpu.telemetry.fleetdelta import collect_hists
+        from torchft_tpu.telemetry.timeseries import build_series
+
+        report: Dict[str, Any] = {
+            "step": self._step,
+            "epoch": self._quorum_id,
+            "stuck": bool(self._watchdog.stalled),
+            "slo_breach": bool(self._slo.breached()),
+            "local_step_p50_s": float(telemetry.LEDGER.local_p50() or 0.0),
+            "last_heal_ts": float(self._last_heal_ts),
+            "summary": telemetry.summary(),
+            "anatomy": telemetry.LEDGER.summary(),
+            "hist": collect_hists(),
+        }
+        diagnosis = getattr(self, "_diagnosis", None)
+        if diagnosis is not None and diagnosis.bundle_count:
+            report["diag_bundles"] = diagnosis.bundle_count
+            report["diag_last"] = diagnosis.last_bundle or ""
+            report["diag_dir"] = diagnosis.directory or ""
+        series = build_series(
+            slo_breach=bool(self._slo.breached()),
+            stuck=bool(self._watchdog.stalled),
+            divergence=bool(self._divergence_latched),
+        )
+        if series:
+            report["series"] = series
+        return report
+
+    def _telemetry_payload_delta(self) -> Optional[Dict[str, Any]]:
+        """Delta-encoded piggyback (ISSUE 16): the report is flattened
+        and only fields changed since the lighthouse's last ack ship, so
+        steady-state bytes are O(changed), not O(report). Spans ride
+        OUTSIDE the blob as the lowest-priority tier: when blob + spans
+        would blow the 64KiB cap the spans are requeued for a lighter
+        round instead of starving the latches inside the blob."""
+        import time as _time
+
+        from torchft_tpu.telemetry.fleetdelta import max_blob_bytes
+
+        t0 = _time.perf_counter()
+        try:
+            enc = self._delta_encoder()
+            blob = enc.encode(self._telemetry_report())
+            payload: Dict[str, Any] = {"tdelta": blob}
+            telemetry.TELEMETRY_BYTES.labels(channel="piggyback").inc(
+                len(blob)
+            )
+            spans = telemetry.TRACER.drain_chrome_fragment()
+            if spans:
+                if len(blob) + len(spans) <= max_blob_bytes():
+                    payload["spans"] = spans
+                    telemetry.TELEMETRY_BYTES.labels(channel="spans").inc(
+                        len(spans)
+                    )
+                else:
+                    # tier 3 drops first — requeue, don't lose them
+                    telemetry.TRACER.requeue_last_batch()
+            return payload
+        except Exception:  # noqa: BLE001 — observability must not fail quorum
+            return None
+        finally:
+            # the telemetry plane meters itself: encode+drain cost is a
+            # first-class anatomy phase, so an overhead regression shows
+            # up in the same percentile tables as compute/wire
+            telemetry.LEDGER.record(
+                "telemetry", _time.perf_counter() - t0
+            )
+
     def _telemetry_payload(self) -> Optional[Dict[str, Any]]:
         """Compact per-replica report piggybacked on the quorum RPC:
         counters digest + recent span batch + health scalars. The manager
         server forwards it to the lighthouse for /cluster.json and the
         merged /trace. Must never fail the quorum path. Kill switch:
-        ``TORCHFT_TELEMETRY_PIGGYBACK=0``."""
+        ``TORCHFT_TELEMETRY_PIGGYBACK=0``. Default wire format is the
+        delta encoding (telemetry/fleetdelta.py); set
+        ``TORCHFT_TELEMETRY_DELTA=0`` for the legacy full-JSON payload."""
+        if os.environ.get("TORCHFT_TELEMETRY_PIGGYBACK", "1") == "0":
+            return None
+        from torchft_tpu.telemetry.fleetdelta import delta_enabled
+
+        if delta_enabled():
+            return self._telemetry_payload_delta()
+        return self._telemetry_payload_json()
+
+    def _telemetry_payload_json(self) -> Optional[Dict[str, Any]]:
+        """The legacy full-JSON payload — the ``TORCHFT_TELEMETRY_DELTA=0``
+        wire format, and ALSO the out-of-band stall push's format even in
+        delta mode: the push runs on its own thread, and the delta
+        encoder is thread-compatible (quorum-path-only) — touching it
+        here would race the quorum thread's encode, and an out-of-order
+        heartbeat would break the version chain into resync round-trips
+        that drop time-series samples. The lighthouse ingest is
+        format-blind, so a self-contained JSON row lands regardless of
+        what the delta chain is doing."""
         import json as _json
 
         if os.environ.get("TORCHFT_TELEMETRY_PIGGYBACK", "1") == "0":
@@ -964,6 +1076,19 @@ class Manager:
                 telemetry.TRACER.requeue_last_batch()
                 raise
             q_span.set(quorum_id=quorum.quorum_id, heal=quorum.heal)
+
+        # telemetry-delta ack loop (ISSUE 16): the lighthouse's
+        # last-applied version rides the quorum reply; feeding it to the
+        # encoder is what collapses the NEXT piggyback to only-changed
+        # fields (and triggers a full resync when the lighthouse lost
+        # our chain — restart, eviction, version skew)
+        if quorum.telemetry_ack:
+            enc = getattr(self, "_tdelta_encoder", None)
+            if enc is not None:
+                try:
+                    enc.on_ack(quorum.telemetry_ack)
+                except Exception:  # noqa: BLE001 — never fail quorum
+                    pass
 
         # Async quorum overlaps the forward pass, so a healing replica can't
         # participate this step (its state is mid-flight) — take the max-step

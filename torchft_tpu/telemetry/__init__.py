@@ -275,7 +275,7 @@ STEP_PHASE_SECONDS = REGISTRY.histogram(
     "tft_step_phase_seconds",
     "Per-step seconds spent in each anatomy phase (compute / host_copy / "
     "quantize / wire / dequant_reduce / quorum_wait / commit_barrier / "
-    "heal / idle — docs/observability.md 'Step anatomy')",
+    "heal / telemetry / idle — docs/observability.md 'Step anatomy')",
     labelnames=("phase",),
     buckets=LOG2_BUCKETS,
 )
@@ -290,6 +290,20 @@ STEP_LOCAL_SECONDS = REGISTRY.histogram(
     "quorum_wait, commit_barrier, heal) — the straggler-discriminating "
     "signal piggybacked to the lighthouse",
     buckets=LOG2_BUCKETS,
+)
+
+# self-metering (ISSUE 16): bytes the telemetry plane itself moves, per
+# channel. `piggyback` = delta/JSON blobs attached to quorum RPCs,
+# `spans` = chrome-trace fragments riding the same RPC; the lighthouse
+# meters its own `scrape` channel (HTTP bodies served) as the native
+# torchft_telemetry_bytes_total counterpart. The budget gate
+# (benchmarks/telemetry_overhead.py) keys off the step-rate delta, but
+# this counter is what tells you WHERE an overhead regression lives.
+TELEMETRY_BYTES = REGISTRY.counter(
+    "tft_telemetry_bytes_total",
+    "Bytes moved by the telemetry plane itself, by channel "
+    "(piggyback / spans)",
+    labelnames=("channel",),
 )
 
 # divergence sentinel (ISSUE 10): cross-group post-reduce digest
@@ -383,7 +397,20 @@ for _slo in ("step_time", "rejoin_commit"):
     SLO_BREACH_TOTAL.labels(slo=_slo)
 for _plane in ("py", "native"):
     PROF_SAMPLES.labels(plane=_plane)
-del _role, _outcome, _kind, _result, _reason, _stage, _phase, _slo, _plane
+for _channel in ("piggyback", "spans"):
+    TELEMETRY_BYTES.labels(channel=_channel)
+del (
+    _role,
+    _outcome,
+    _kind,
+    _result,
+    _reason,
+    _stage,
+    _phase,
+    _slo,
+    _plane,
+    _channel,
+)
 
 
 # ---------------------------------------------------------------------------

@@ -73,6 +73,7 @@ PHASES = (
     "quorum_wait",
     "commit_barrier",
     "heal",
+    "telemetry",
     "idle",
 )
 

@@ -297,6 +297,15 @@ class Histogram(_Metric):
         with self._lock:
             return self._sum
 
+    def raw_counts(self) -> List[int]:
+        """Raw (non-cumulative) per-bucket counts, last slot is +Inf.
+
+        Mergeable representation: folding N histograms on the same grid is
+        elementwise addition, which the fleet-rollup path relies on.
+        """
+        with self._lock:
+            return list(self._counts)
+
     def snapshot(self) -> Dict:
         """Cumulative bucket counts + sum + count, read under one lock."""
         with self._lock:
