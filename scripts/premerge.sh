@@ -1,11 +1,11 @@
 #!/usr/bin/env bash
 # Pre-merge gate — the checklist that used to live only as prose in
 # docs/static_analysis.md, as one runnable script (ISSUE 11, extended by
-# ISSUE 15):
+# ISSUE 15 and ISSUE 20):
 #
 #   1. the static-analysis gate  (python -m torchft_tpu.analysis —
 #      concurrency lint, wire/doc drift, and the clang-free native
-#      concurrency lint)
+#      concurrency lint; incrementally cached under .analysis_cache/)
 #   2. the native strict-warning build  (make -C native warn, -Werror);
 #      when clang-tidy is on PATH the full `make -C native tidy` gate
 #      runs too instead of being silently skipped
@@ -16,9 +16,10 @@
 #   5. the telemetry-overhead smoke  (piggyback armed vs disarmed
 #      headline leg, gate <=1% / TORCHFT_TELEMETRY_BUDGET_PCT —
 #      ISSUE 16's self-metering budget)
-#   6. the protocol verification gate (ISSUE 15): exhaustive bounded
-#      model check of the quorum/commit spec (crash at every transition
-#      point) + a conformance replay of the quick matrix's trails
+#   6. the protocol verification gate (ISSUE 15/20): bounded model check
+#      of the quorum/commit spec AND the HA lighthouse tier (crash at
+#      every transition point, POR+symmetry reductions) + a conformance
+#      replay of the quick matrix's trails
 #
 # Exit 0 = every gate clean. Each gate runs even if an earlier one
 # failed, so one invocation reports the full damage; the exit code is
@@ -31,6 +32,15 @@
 #   scripts/premerge.sh --no-matrix  # skip the faultmatrix (seconds-fast;
 #                                    # gate 6 then skips the replay leg)
 #   scripts/premerge.sh --no-smoke   # skip both overhead smokes
+#   scripts/premerge.sh --json       # append a machine-readable per-gate
+#                                    # summary (name/status/seconds) as the
+#                                    # final stdout line — skips (e.g. the
+#                                    # clang-tidy exit-3 skip) are VISIBLE
+#                                    # records, never silent
+#
+# The gate-name ids recorded by --json are drift-checked against the
+# docs/static_analysis.md "Pre-merge gates" table by
+# `python -m torchft_tpu.analysis` (docdrift: premerge-gate-drift).
 set -u -o pipefail
 
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -38,48 +48,76 @@ cd "$REPO"
 
 RUN_MATRIX=1
 RUN_SMOKE=1
+JSON_OUT=0
 for arg in "$@"; do
   case "$arg" in
     --no-matrix) RUN_MATRIX=0 ;;
     --no-smoke) RUN_SMOKE=0 ;;
-    *) echo "unknown arg: $arg (known: --no-matrix --no-smoke)" >&2; exit 2 ;;
+    --json) JSON_OUT=1 ;;
+    *) echo "unknown arg: $arg (known: --no-matrix --no-smoke --json)" >&2
+       exit 2 ;;
   esac
 done
 
 rc=0
+GATE_RECORDS=()
 fail() { echo "premerge: GATE FAILED: $1" >&2; rc=1; }
+# record_gate <name> <passed|failed|skipped> <seconds> — one record per
+# gate id; the docdrift premerge-gate-drift rule greps these call sites
+record_gate() {
+  GATE_RECORDS+=("{\"name\":\"$1\",\"status\":\"$2\",\"seconds\":$3}")
+}
 
 echo "=== [1/6] static-analysis gate (python -m torchft_tpu.analysis) ==="
-if ! JAX_PLATFORMS=cpu python -m torchft_tpu.analysis; then
+t0=$SECONDS
+if JAX_PLATFORMS=cpu python -m torchft_tpu.analysis; then
+  record_gate "analysis" passed $((SECONDS - t0))
+else
   fail "analysis"
+  record_gate "analysis" failed $((SECONDS - t0))
 fi
 
 echo "=== [2/6] native strict-warning build (make -C native warn) ==="
-if ! make -C native warn; then
+t0=$SECONDS
+if make -C native warn; then
+  record_gate "native-warn" passed $((SECONDS - t0))
+else
   fail "native warn"
+  record_gate "native-warn" failed $((SECONDS - t0))
 fi
 # the real clang-tidy gate, when the toolchain is present: exit-3
-# (clang-tidy missing) stays a skip with a message, but a container
-# that HAS clang-tidy runs the full baseline-diffed gate — no more
-# silently weaker checking on better-equipped boxes
+# (clang-tidy missing) stays a skip with a message AND a skipped record
+# in the --json summary, but a container that HAS clang-tidy runs the
+# full baseline-diffed gate — no more silently weaker checking on
+# better-equipped boxes
 if command -v clang-tidy >/dev/null 2>&1; then
   echo "--- clang-tidy present: running make -C native tidy"
-  if ! make -C native tidy; then
+  t0=$SECONDS
+  if make -C native tidy; then
+    record_gate "native-tidy" passed $((SECONDS - t0))
+  else
     fail "native tidy"
+    record_gate "native-tidy" failed $((SECONDS - t0))
   fi
 else
   echo "--- clang-tidy not on PATH: tidy gate skipped (make warn ran)"
+  record_gate "native-tidy" skipped 0
 fi
 
 MATRIX_DIR="${TMPDIR:-/tmp}/premerge_faultmatrix"
 if [ "$RUN_MATRIX" = 1 ]; then
   echo "=== [3/6] quick faultmatrix subset (runner --quick) ==="
-  if ! JAX_PLATFORMS=cpu python -m torchft_tpu.faultinject.runner --quick \
+  t0=$SECONDS
+  if JAX_PLATFORMS=cpu python -m torchft_tpu.faultinject.runner --quick \
       --outdir "$MATRIX_DIR"; then
+    record_gate "faultmatrix-quick" passed $((SECONDS - t0))
+  else
     fail "faultmatrix --quick"
+    record_gate "faultmatrix-quick" failed $((SECONDS - t0))
   fi
 else
   echo "=== [3/6] faultmatrix skipped (--no-matrix) ==="
+  record_gate "faultmatrix-quick" skipped 0
 fi
 
 if [ "$RUN_SMOKE" = 1 ]; then
@@ -87,31 +125,45 @@ if [ "$RUN_SMOKE" = 1 ]; then
   # a single short leg on a loaded box can swing past the gate on
   # weather (the row's own note says so) — one breach earns one retry,
   # and only a breach on BOTH runs fails the gate
+  t0=$SECONDS
   if ! JAX_PLATFORMS=cpu python -m torchft_tpu.benchmarks.profiler_overhead \
       --smoke; then
     echo "premerge: smoke breached once — retrying (box weather?)" >&2
     if ! JAX_PLATFORMS=cpu python -m torchft_tpu.benchmarks.profiler_overhead \
         --smoke; then
       fail "profiler-overhead smoke (breached twice)"
+      record_gate "profiler-smoke" failed $((SECONDS - t0))
+    else
+      record_gate "profiler-smoke" passed $((SECONDS - t0))
     fi
+  else
+    record_gate "profiler-smoke" passed $((SECONDS - t0))
   fi
 else
   echo "=== [4/6] profiler-overhead smoke skipped (--no-smoke) ==="
+  record_gate "profiler-smoke" skipped 0
 fi
 
 if [ "$RUN_SMOKE" = 1 ]; then
   echo "=== [5/6] telemetry-overhead smoke (piggyback armed vs disarmed, gate <=1%) ==="
   # same weather policy as gate 4: one breach earns one retry
+  t0=$SECONDS
   if ! JAX_PLATFORMS=cpu python -m torchft_tpu.benchmarks.telemetry_overhead \
       --smoke; then
     echo "premerge: smoke breached once — retrying (box weather?)" >&2
     if ! JAX_PLATFORMS=cpu python -m torchft_tpu.benchmarks.telemetry_overhead \
         --smoke; then
       fail "telemetry-overhead smoke (breached twice)"
+      record_gate "telemetry-smoke" failed $((SECONDS - t0))
+    else
+      record_gate "telemetry-smoke" passed $((SECONDS - t0))
     fi
+  else
+    record_gate "telemetry-smoke" passed $((SECONDS - t0))
   fi
 else
   echo "=== [5/6] telemetry-overhead smoke skipped (--no-smoke) ==="
+  record_gate "telemetry-smoke" skipped 0
 fi
 
 echo "=== [6/6] protocol verification (model check + conformance replay) ==="
@@ -119,12 +171,21 @@ PROTO_ARGS=()
 if [ "$RUN_MATRIX" = 1 ] && [ -d "$MATRIX_DIR" ]; then
   PROTO_ARGS+=(--conformance "$MATRIX_DIR")
 fi
-if ! JAX_PLATFORMS=cpu python -m torchft_tpu.analysis.protocol \
+t0=$SECONDS
+if JAX_PLATFORMS=cpu python -m torchft_tpu.analysis.protocol \
     ${PROTO_ARGS[@]+"${PROTO_ARGS[@]}"}; then
+  record_gate "protocol" passed $((SECONDS - t0))
+else
   fail "protocol verification"
+  record_gate "protocol" failed $((SECONDS - t0))
 fi
 
 if [ "$rc" = 0 ]; then
   echo "premerge: all gates clean"
+fi
+if [ "$JSON_OUT" = 1 ]; then
+  ok=$([ "$rc" = 0 ] && echo true || echo false)
+  gates=$(IFS=,; echo "${GATE_RECORDS[*]}")
+  echo "{\"ok\":${ok},\"gates\":[${gates}]}"
 fi
 exit "$rc"

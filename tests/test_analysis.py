@@ -11,8 +11,10 @@ Two halves:
   they left ``test_tracing.py``.
 """
 
+import glob
 import json
 import os
+import re
 import subprocess
 import sys
 
@@ -426,3 +428,168 @@ class TestNativeLintFixtures:
         )
         active, _suppressed, _stale = baseline.apply(finds)
         assert active == [], [f.render() for f in active]
+
+
+# ---------------------------------------------------------------------------
+# premerge gate-id drift (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestPremergeGateDrift:
+    DOC = (
+        "### Pre-merge gates\n\nprose\n\n"
+        "| gate | what runs |\n"
+        "|---|---|\n"
+        "| `analysis` | x |\n"
+        "| `ghost-gate` | x |\n"
+    )
+    SCRIPT = (
+        'record_gate "analysis" passed 1\n'
+        '  record_gate "native-warn" skipped 0\n'
+    )
+
+    def test_both_directions_flagged(self):
+        finds = docdrift.check_premerge_gates(self.DOC, self.SCRIPT)
+        msgs = {f.symbol: f.message for f in finds}
+        # documented but never recorded; recorded but undocumented
+        assert "ghost-gate" in msgs and "no record_gate" in msgs["ghost-gate"]
+        assert "native-warn" in msgs and "missing from" in msgs["native-warn"]
+        assert "analysis" not in msgs
+
+    def test_missing_table_is_a_finding(self):
+        finds = docdrift.check_premerge_gates("# no section\n", self.SCRIPT)
+        assert [f.symbol for f in finds] == ["<table>"]
+
+    def test_missing_record_sites_is_a_finding(self):
+        finds = docdrift.check_premerge_gates(self.DOC, "echo hi\n")
+        assert [f.symbol for f in finds] == ["<script>"]
+
+    def test_real_script_records_all_six_gates(self):
+        """Every gate in premerge.sh emits a --json record — including
+        the clang-tidy skip, which must be VISIBLE, not silent."""
+        with open(os.path.join(REPO, "scripts", "premerge.sh")) as f:
+            ids = set(re.findall(r'record_gate "([a-z0-9-]+)"', f.read()))
+        assert ids == {"analysis", "native-warn", "native-tidy",
+                       "faultmatrix-quick", "profiler-smoke",
+                       "telemetry-smoke", "protocol"}
+
+    def test_clean_tree(self):
+        finds = [
+            f for f in docdrift.run() if f.rule == "premerge-gate-drift"
+        ]
+        assert finds == [], [f.render() for f in finds]
+
+
+# ---------------------------------------------------------------------------
+# incremental analysis cache (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestAnalysisCache:
+    def test_fingerprint_tracks_edits_and_adds(self, tmp_path):
+        from torchft_tpu.analysis.cache import fingerprint
+
+        (tmp_path / "native").mkdir()
+        hdr = tmp_path / "native" / "a.h"
+        hdr.write_text("int x;\n")
+        pats = ("native/*.h",)
+        base = fingerprint(str(tmp_path), pats)
+        assert fingerprint(str(tmp_path), pats) == base  # deterministic
+        hdr.write_text("int y;\n")
+        edited = fingerprint(str(tmp_path), pats)
+        assert edited != base  # edit -> new digest
+        (tmp_path / "native" / "b.h").write_text("")
+        assert fingerprint(str(tmp_path), pats) != edited  # add -> new digest
+
+    def test_edit_refires_hit_replays(self, tmp_path):
+        """The correctness contract: unchanged inputs -> the stored
+        findings replay verbatim; ANY scanned-file edit -> miss."""
+        from torchft_tpu.analysis.cache import AnalysisCache
+
+        (tmp_path / "native").mkdir()
+        hdr = tmp_path / "native" / "a.h"
+        hdr.write_text("// v1\n")
+        cache = AnalysisCache(str(tmp_path))
+        assert cache.get("nativelint") is None  # cold
+        finds = [Finding("cpp-atomic-no-order-reason", "native/a.h", 3,
+                         "bump:relaxed", "msg")]
+        cache.put("nativelint", finds)
+        warm = AnalysisCache(str(tmp_path))
+        assert warm.get("nativelint") == finds
+        assert warm.hits == ["nativelint"]
+        hdr.write_text("// v2\n")
+        stale = AnalysisCache(str(tmp_path))
+        assert stale.get("nativelint") is None  # edit -> re-fire
+
+    def test_unknown_analyzer_never_caches(self, tmp_path):
+        from torchft_tpu.analysis.cache import AnalysisCache
+
+        cache = AnalysisCache(str(tmp_path))
+        cache.put("mystery", [])
+        assert cache.get("mystery") is None
+        assert not (tmp_path / ".analysis_cache" / "mystery.json").exists()
+
+    def test_corrupt_cache_file_is_a_miss(self, tmp_path):
+        from torchft_tpu.analysis.cache import AnalysisCache
+
+        (tmp_path / "native").mkdir()
+        (tmp_path / "native" / "a.h").write_text("int x;\n")
+        cache = AnalysisCache(str(tmp_path))
+        cache.put("nativelint", [])
+        (tmp_path / ".analysis_cache" / "nativelint.json").write_text("{oops")
+        assert AnalysisCache(str(tmp_path)).get("nativelint") is None
+
+    def test_cached_gate_verdict_identical_to_fresh(self):
+        """End to end on the real tree: a warm cache replays byte-equal
+        finding keys for every analyzer."""
+        from torchft_tpu.analysis.cache import AnalysisCache
+
+        cold_cache = AnalysisCache()
+        cold = run_all(cache=cold_cache)
+        warm_cache = AnalysisCache()
+        warm = run_all(cache=warm_cache)
+        assert set(warm_cache.hits) == {"concurrency", "wiredrift",
+                                        "docdrift", "nativelint"}
+        assert warm_cache.misses == []
+        for name in cold:
+            assert [f.key for f in cold[name]] == \
+                [f.key for f in warm[name]], name
+
+
+# ---------------------------------------------------------------------------
+# telemetry_delta.h nativelint pin (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetryDeltaPin:
+    REL = os.path.join("native", "telemetry_delta.h")
+
+    def test_file_is_in_the_scanned_set(self):
+        scanned = set()
+        for pat in nativelint.NATIVE_GLOBS:
+            scanned.update(glob.glob(os.path.join(REPO, pat)))
+        assert os.path.join(REPO, self.REL) in scanned
+
+    def test_clean_tree_zero_findings(self):
+        """PR 16's delta ledger is mutex-guarded by design — zero atomic
+        sites, so zero annotation findings; this pins that a future
+        atomic added without a reason lands as an ACTIVE finding."""
+        finds = [f for f in nativelint.run() if "telemetry_delta" in f.path]
+        assert finds == [], [f.render() for f in finds]
+
+    def test_seeded_unannotated_atomic_fires(self):
+        """The pin is only meaningful if the lint would actually catch a
+        regression in THIS file: seed one unannotated relaxed op into
+        the real source and watch the rule fire."""
+        with open(os.path.join(REPO, self.REL), encoding="utf-8") as f:
+            src = f.read()
+        seeded = src + (
+            "\ninline void tdx_bump(std::atomic<unsigned long>& c) {\n"
+            "  c.fetch_add(1, std::memory_order_relaxed);\n"
+            "}\n"
+        )
+        finds = nativelint.analyze_sources([("telemetry_delta.h", seeded)])
+        hits = [f for f in finds
+                if f.rule == "cpp-atomic-no-order-reason"
+                and "tdx_bump" in f.symbol]
+        assert hits, [f.render() for f in finds]

@@ -1,0 +1,47 @@
+"""Replay the checker-compiled fault schedules through the real runner
+(ISSUE 20 tentpole part 3 acceptance).
+
+The shipped ``torchft_tpu/faultinject/compiled/*.json`` descriptors —
+lowered from sampled coverage paths of the ``sync-2g`` model by
+``analysis/protocol/compile.py`` — must run green through the actual
+faultmatrix tier: the injected site fires (evidence record), the victim
+dies and respawns, the survivors converge, final checksums are
+bit-identical, and the conformance replay of the produced trails is
+clean. Slow-marked: three full multi-process scenarios (~2 min); tier-1
+covers the fast half (descriptor pinning, lowering unit tests, the
+in-process round trip) in ``test_protocol.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_compiled_schedules_replay_green(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "torchft_tpu.faultinject.runner",
+         "--compiled", "--outdir", str(tmp_path)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "fault matrix clean" in proc.stdout
+    with open(tmp_path / "faultmatrix.json", encoding="utf-8") as f:
+        report = json.load(f)
+    by_name = {r["scenario"]: r for r in report["results"]}
+    expected = {"compiled_kill_quorum_reply", "compiled_kill_commit_vote",
+                "compiled_kill_next_collective"}
+    assert expected <= set(by_name), sorted(by_name)
+    for name in expected:
+        res = by_name[name]
+        assert res["status"] == "passed", res
+        # the compiled site fired, the victim died and respawned
+        assert res["fired"] >= 1 and res["respawns"] >= 1, res

@@ -1,27 +1,46 @@
-"""Tests for the FT-protocol verification plane (ISSUE 15).
+"""Tests for the FT-protocol verification plane (ISSUE 15 + ISSUE 20).
 
-Three layers, mirroring the package:
+Five layers, mirroring the package:
 
 * **model checker** — the shipped gate configurations must verify clean
   under exhaustive bounded exploration (crash injected at every
   transition point), and every deliberately-broken spec variant (the
   seeded fixtures) must produce exactly its planted violation class —
   the checker is itself code under test, so both directions matter;
+* **reductions** (ISSUE 20) — POR + symmetry must reproduce the PR 15
+  verdicts at ≥5× fewer explored states, bitstate must mark itself
+  approximate, and budget truncation must be loud, never a silent pass;
+* **the HA tier** (ISSUE 20) — the four Raft-lighthouse gate configs
+  verify clean within their stated state budgets, and each broken HA
+  variant fixture is caught with its planted invariant + a trace, in
+  both reduced and reference modes;
 * **trace conformance** — each illegal-transition rule catches its
   seeded trail (the ``trail_healing_commit.jsonl`` fixture et al.) and
   passes legal lifecycles, including the SIGKILL+respawn append pattern
   real faultmatrix trails produce;
-* **the CLI** — ``python -m torchft_tpu.analysis.protocol`` is premerge
-  gate [5]; its exit-code contract is pinned here.
+* **the trace→schedule compiler + CLI** — checker traces lower into the
+  faultinject grammar deterministically (the shipped
+  ``faultinject/compiled/`` descriptors are pinned regenerable), and
+  ``python -m torchft_tpu.analysis.protocol`` is premerge gate [6] with
+  its exit-code contract pinned here.
 """
 
 import json
 import os
+import signal
 import subprocess
 import sys
 
 from torchft_tpu.analysis.protocol import SpecConfig, check
-from torchft_tpu.analysis.protocol.checker import GATE_CONFIGS
+from torchft_tpu.analysis.protocol.checker import (
+    GATE_CONFIGS,
+    HA_STATE_BUDGETS,
+)
+from torchft_tpu.analysis.protocol.compile import (
+    compile_gate_schedules,
+    compile_trace,
+    sample_paths,
+)
 from torchft_tpu.analysis.protocol.conformance import (
     check_records,
     check_trail_file,
@@ -30,9 +49,35 @@ from torchft_tpu.analysis.protocol.conformance import (
 REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
 
+# PR 15's plain-DFS explored-state counts for the legacy gate configs —
+# measured by running the PR 15 checker (commit 7020015) against the
+# unchanged single-lighthouse spec. The ISSUE 20 acceptance bar: the
+# POR+symmetry checker reproduces these verdicts at >=5x fewer states.
+PR15_STATES = {
+    "sync-2g": 3082,
+    "pipelined-2g": 6126,
+    "divergence-fenced-2g": 14416,
+    "sync-3g": 118466,
+}
+
+# fixture -> the SpecConfig knob whose healthy setting makes it clean
+HA_FIXTURES = {
+    "spec_split_brain_leaders.json": ("raft_single_vote", True),
+    "spec_stale_leader_commit.json": ("stale_leader_fence", True),
+    "spec_out_of_order_delta.json": ("ordered_deltas", True),
+}
+
 
 def _kinds(result):
     return sorted({v.invariant for v in result.violations})
+
+
+def _load_fixture(name):
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+        doc = json.load(f)
+    doc.pop("_comment", None)
+    expect = doc.pop("expect_violation")
+    return doc, expect
 
 
 # ---------------------------------------------------------------------------
@@ -45,21 +90,28 @@ class TestModelChecker:
         r = check(GATE_CONFIGS["sync-2g"])
         assert r.ok, [v.render() for v in r.violations]
         # exhaustive means EXPLORED: a broken scheduler that visits 3
-        # states would also report "no violations"
-        assert r.states > 1000
+        # states would also report "no violations" (reduced counts —
+        # the PR 15 plain-DFS bound lives in TestReductions)
+        assert r.states > 100
         assert r.terminals > 0
+        assert not r.truncated and not r.approximate
 
     def test_pipelined_2g_clean(self):
         r = check(GATE_CONFIGS["pipelined-2g"])
         assert r.ok, [v.render() for v in r.violations]
-        assert r.states > 1000
+        assert r.states > 100
 
     def test_divergence_fenced_2g_clean(self):
         r = check(GATE_CONFIGS["divergence-fenced-2g"])
         assert r.ok, [v.render() for v in r.violations]
-        assert r.states > 1000
+        assert r.states > 100
 
-    # sync-3g (~100k states) runs in premerge gate [5], not tier-1.
+    def test_sync_3g_clean(self):
+        # ~118k states under PR 15's plain DFS; symmetry over 3
+        # interchangeable groups makes it tier-1-sized now
+        r = check(GATE_CONFIGS["sync-3g"])
+        assert r.ok and not r.truncated
+        assert r.states > 100
 
     def test_crash_interleaved_at_every_point(self):
         """The SIGKILL-anywhere contract: with a crash budget, the
@@ -136,6 +188,102 @@ class TestBrokenVariantsCaught:
             respawn_budget=0, corrupt_budget=1, fence_divergence=False,
         )
         assert "I1-unique-commit" in _kinds(check(broken))
+
+
+# ---------------------------------------------------------------------------
+# checker scale-up: POR + symmetry + bitstate + budgets (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+class TestReductions:
+    def test_legacy_verdicts_identical_at_5x_fewer_states(self):
+        """The acceptance bar: all four PR 15 gate configs, identical
+        (clean) verdicts, >=5x fewer explored states under the default
+        POR+symmetry reductions."""
+        for name, pr15 in PR15_STATES.items():
+            r = check(GATE_CONFIGS[name])
+            assert r.ok and not r.truncated, name
+            assert r.states * 5 <= pr15, (name, r.states, pr15)
+
+    def test_reductions_agree_with_reference_mode(self):
+        """Soundness spot-check: reductions on vs off, same verdict —
+        on a clean config AND on a broken one (the violation must
+        survive the pruning)."""
+        for name in ("sync-2g", "pipelined-2g"):
+            red = check(GATE_CONFIGS[name])
+            ref = check(GATE_CONFIGS[name], por=False, symmetry=False)
+            assert red.ok and ref.ok, name
+        doc, expect = _load_fixture("spec_double_commit.json")
+        broken = SpecConfig(**doc)
+        red = check(broken, max_violations=1)
+        ref = check(broken, max_violations=1, por=False, symmetry=False)
+        assert expect in _kinds(red) and expect in _kinds(ref)
+
+    def test_bitstate_is_loudly_approximate(self):
+        r = check(GATE_CONFIGS["sync-2g"], bitstate=True)
+        assert r.approximate is True
+        # and the exact default never claims to be approximate
+        assert check(GATE_CONFIGS["sync-2g"]).approximate is False
+
+    def test_budget_truncation_is_not_a_clean_verdict(self):
+        r = check(GATE_CONFIGS["sync-2g"], max_states=50)
+        assert r.truncated
+        assert not r.ok  # a truncated run must never read as verified
+        assert r.truncated_states > 0  # the unexplored frontier is counted
+
+    def test_early_stop_on_max_violations(self):
+        """``max_violations=1`` turns a broken fixture into a fast
+        fail-on-first run — marked truncated, never ok."""
+        doc, expect = _load_fixture("spec_stale_leader_commit.json")
+        fast = check(SpecConfig(**doc), max_violations=1)
+        assert len(fast.violations) == 1
+        assert fast.violations[0].invariant == expect
+        assert fast.truncated and not fast.ok
+        full = check(SpecConfig(**doc))
+        assert fast.states < full.states
+
+
+# ---------------------------------------------------------------------------
+# the HA tier: Raft lighthouse + membership deltas + quorum tree
+# ---------------------------------------------------------------------------
+
+
+class TestHaGates:
+    def test_ha_gate_configs_clean_within_stated_budget(self):
+        ha = {n: c for n, c in GATE_CONFIGS.items() if n.startswith("ha-")}
+        assert len(ha) >= 4, sorted(ha)
+        for name, cfg in ha.items():
+            budget = HA_STATE_BUDGETS[name]
+            r = check(cfg, max_states=budget)
+            assert r.ok and not r.truncated, (
+                name, r.states, [v.render() for v in r.violations],
+            )
+            assert r.states <= budget
+
+
+class TestBrokenHaVariantsCaught:
+    def test_each_fixture_caught_with_planted_class_and_trace(self):
+        """Every broken HA fixture fires EXACTLY its planted invariant —
+        in the reduced mode and in the reference (no-POR, no-symmetry)
+        mode, with a rendered action trace either way."""
+        for name in HA_FIXTURES:
+            doc, expect = _load_fixture(name)
+            broken = SpecConfig(**doc)
+            for kwargs in ({}, {"por": False, "symmetry": False}):
+                r = check(broken, max_violations=1, **kwargs)
+                assert _kinds(r) == [expect], (name, kwargs, _kinds(r))
+                v = r.violations[0]
+                assert v.trace, (name, kwargs)
+                assert expect in v.render()
+
+    def test_fixed_twins_are_clean(self):
+        """The same bounds with the protection ON must verify clean —
+        each HA protection is proven load-bearing."""
+        for name, (knob, healthy) in HA_FIXTURES.items():
+            doc, _expect = _load_fixture(name)
+            doc[knob] = healthy
+            r = check(SpecConfig(**doc))
+            assert r.ok, (name, [v.render() for v in r.violations])
 
 
 # ---------------------------------------------------------------------------
@@ -279,7 +427,192 @@ class TestConformance:
 
 
 # ---------------------------------------------------------------------------
-# CLI (premerge gate [5])
+# trace -> schedule compiler (ISSUE 20 tentpole part 3)
+# ---------------------------------------------------------------------------
+
+
+class TestCompileTrace:
+    PREFIX = ["join(0)", "join(1)", "form(r0,step=0)"]
+
+    def test_crash_after_work_before_vote(self):
+        cs = compile_trace(self.PREFIX + ["work(1)", "crash(1)"], name="t")
+        assert cs.victim == 1 and cs.expect_victim_death and cs.runnable
+        (rule,) = cs.victim_schedule["rules"]
+        assert rule == {"site": "commit.vote", "match": "prepare",
+                        "nth": 1, "action": "kill", "sig": 9}
+
+    def test_crash_after_vote(self):
+        cs = compile_trace(
+            self.PREFIX + ["work(1)", "vote(1)", "crash(1)"], name="t",
+        )
+        (rule,) = cs.victim_schedule["rules"]
+        # the vote is on the wire; the nearest hook is the NEXT collective
+        assert rule["site"] == "collective.issue"
+        assert rule["match"] == "allreduce" and rule["nth"] == 2
+
+    def test_crash_before_contributing(self):
+        cs = compile_trace(self.PREFIX + ["crash(0)"], name="t")
+        assert cs.victim == 0
+        (rule,) = cs.victim_schedule["rules"]
+        assert rule["site"] == "quorum.reply" and rule["nth"] == 1
+
+    def test_work_corrupt_arms_the_fence(self):
+        cs = compile_trace(self.PREFIX + ["work_corrupt(0)"], name="t")
+        (rule,) = cs.victim_schedule["rules"]
+        assert rule["site"] == "collective.complete"
+        assert rule["action"] == "corrupt"
+        assert cs.common_env["TORCHFT_DIVERGENCE_FENCE"] == "1"
+        assert not cs.expect_victim_death
+
+    def test_heal_fail_lowers_to_survivor_serve_drop(self):
+        cs = compile_trace(self.PREFIX + ["heal_fail(1)"], name="t")
+        assert cs.victim_schedule is None
+        (rule,) = cs.survivor_schedule["rules"]
+        assert rule == {"site": "ckpt.serve", "nth": 1, "action": "drop"}
+        assert cs.runnable
+
+    def test_ha_actions_collect_as_unlowered(self):
+        trace = ["lh_campaign(0,t1)", "lh_elect(0,t1)", "delta(1,v1)"]
+        cs = compile_trace(trace, name="t")
+        assert cs.unlowered == trace
+        assert not cs.runnable  # coordinates await the Raft wiring
+
+    def test_second_crash_of_victim_is_unlowerable(self):
+        cs = compile_trace(
+            self.PREFIX + ["crash(1)", "respawn(1)", "crash(1)"], name="t",
+        )
+        assert len(cs.victim_schedule["rules"]) == 1
+        assert cs.unlowered == ["crash(1)"]
+
+    def test_compilation_is_deterministic(self):
+        trace = self.PREFIX + ["work(1)", "crash(1)"]
+        a = compile_trace(trace, name="t").to_descriptor()
+        b = compile_trace(trace, name="t").to_descriptor()
+        assert a == b
+
+    def test_descriptor_round_trip(self):
+        from torchft_tpu.analysis.protocol.compile import CompiledSchedule
+
+        cs = compile_trace(self.PREFIX + ["work(1)", "crash(1)"], name="t")
+        doc = cs.to_descriptor()
+        assert CompiledSchedule.from_descriptor(doc).to_descriptor() == doc
+
+
+class TestCompiledGateSet:
+    def test_sample_paths_are_crash_bearing(self):
+        paths = sample_paths(GATE_CONFIGS["sync-2g"], want=8)
+        assert paths
+        for p in paths:
+            assert any(lbl.startswith("crash(") for lbl in p)
+
+    def test_three_distinct_death_coordinates(self):
+        schedules = compile_gate_schedules()
+        sites = {s.victim_schedule["rules"][0]["site"] for s in schedules}
+        assert sites == {"quorum.reply", "commit.vote", "collective.issue"}
+        for s in schedules:
+            assert s.runnable and s.expect_victim_death and s.trace
+
+    def test_shipped_descriptors_are_regenerable(self):
+        """The checked-in faultinject/compiled/*.json set is exactly what
+        the compiler produces today — descriptor drift fails here."""
+        from torchft_tpu.analysis.protocol.compile import SHIPPED_DIR
+
+        for cs in compile_gate_schedules():
+            path = os.path.join(SHIPPED_DIR, f"{cs.name}.json")
+            with open(path, encoding="utf-8") as f:
+                assert json.load(f) == cs.to_descriptor(), path
+
+    def test_runner_loads_shipped_set(self):
+        from torchft_tpu.faultinject.runner import (
+            COMPILED_DIR,
+            load_compiled_scenarios,
+        )
+
+        scenarios = load_compiled_scenarios(COMPILED_DIR)
+        assert len(scenarios) >= 3
+        for s in scenarios:
+            assert s.victim_schedule["rules"]
+            assert s.expect_victim_death and not s.quick
+
+
+# ---------------------------------------------------------------------------
+# round trip: checker violation -> schedule -> real fire -> conformance
+# ---------------------------------------------------------------------------
+
+
+_ROUNDTRIP_WORKER = """\
+import json, sys
+
+# the illegal transition the model trace encodes, as a real trail --
+# written BEFORE the fault loop so it survives the scheduled SIGKILL
+with open(sys.argv[2], "w") as f:
+    for rec in [
+        {"event": "quorum_ready", "quorum_id": 1, "step": 0},
+        {"event": "heal_begin", "step": 2},
+        {"event": "commit", "step": 2},
+    ]:
+        f.write(json.dumps(rec) + "\\n")
+
+from torchft_tpu.faultinject.core import fault_point
+for _ in range(50):
+    fault_point(sys.argv[1], sys.argv[3])
+sys.exit(7)  # the schedule failed to kill us
+"""
+
+
+class TestTraceRoundTrip:
+    def test_counterexample_fires_and_conformance_classifies(self, tmp_path):
+        """Satellite: checker violation trace -> compiled schedule -> the
+        planted site actually fires (evidence record, SIGKILL death) ->
+        conformance classifies the illegal transition."""
+        from torchft_tpu.analysis.protocol.compile import main as cmain
+        from torchft_tpu.faultinject.core import read_evidence
+
+        # 1. broken HA fixture -> counterexample descriptor via the CLI
+        fixture = os.path.join(FIXTURES, "spec_out_of_order_delta.json")
+        assert cmain(["--fixture", fixture, "--outdir", str(tmp_path)]) == 0
+        desc = tmp_path / "counterexample_spec_out_of_order_delta.json"
+        doc = json.loads(desc.read_text())
+        assert doc["source"] == "counterexample"
+        assert doc["runnable"], doc  # the crash lowered to a real site
+        assert doc["unlowered"]  # the delta ops await the Raft wiring
+        rule = doc["victim_schedule"]["rules"][0]
+        assert rule["action"] == "kill" and rule["sig"] == 9
+
+        # 2. replay: a worker hits the planted site until the schedule
+        # kills it; the evidence record proves the site fired
+        worker = tmp_path / "worker.py"
+        worker.write_text(_ROUNDTRIP_WORKER)
+        trail = tmp_path / "trail0.jsonl"
+        evdir = tmp_path / "evidence"
+        env = dict(os.environ)
+        env.pop("TORCHFT_FAULT_SCHEDULE", None)
+        env["TORCHFT_FAULT_SCHEDULE"] = json.dumps(doc["victim_schedule"])
+        env["TORCHFT_FAULT_EVIDENCE_DIR"] = str(evdir)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, str(worker), rule["site"], str(trail),
+             rule.get("match", "")],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == -signal.SIGKILL, (
+            proc.returncode, proc.stdout, proc.stderr,
+        )
+        fired = read_evidence(str(evdir))
+        assert any(
+            r.get("site") == rule["site"] and r.get("action") == "kill"
+            for r in fired
+        ), fired
+
+        # 3. the trail the worker left behind carries the model-level
+        # illegal transition; conformance names it
+        rep = check_trail_file(str(trail))
+        assert [f.rule for f in rep.findings] == ["healing-commit"]
+
+
+# ---------------------------------------------------------------------------
+# CLI (premerge gate [6])
 # ---------------------------------------------------------------------------
 
 
@@ -323,4 +656,6 @@ class TestProtocolCli:
         doc = json.loads(proc.stdout)
         assert doc["ok"] is True
         assert doc["model"]["sync-2g"]["violations"] == []
-        assert doc["model"]["sync-2g"]["states"] > 1000
+        assert doc["model"]["sync-2g"]["states"] > 100
+        assert doc["model"]["sync-2g"]["truncated"] is False
+        assert doc["model"]["sync-2g"]["approximate"] is False

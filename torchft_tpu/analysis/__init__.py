@@ -13,7 +13,7 @@ Four analyzers:
   (wire tags, status codes, RPC opcodes, ``TORCHFT_FI_*`` knobs, fault
   site labels, ``.pyi`` stub coverage, Makefile HDRS coverage);
 * :mod:`~torchft_tpu.analysis.docdrift` — the bidirectional doc/registry
-  catalogs (metrics, events, fault sites);
+  catalogs (metrics, events, fault sites, premerge gate ids);
 * :mod:`~torchft_tpu.analysis.nativelint` — the clang-free lexical
   concurrency lint over ``native/*.{h,cc}`` (lock-order graph,
   blocking-syscall-under-lock, cv predicate loops, non-seq_cst atomic
@@ -22,7 +22,7 @@ Four analyzers:
 The FT-protocol verification plane (executable spec + bounded model
 checker + trace conformance) lives in
 :mod:`~torchft_tpu.analysis.protocol` with its own CLI
-(``python -m torchft_tpu.analysis.protocol``, premerge gate [5]).
+(``python -m torchft_tpu.analysis.protocol``, premerge gate [6]).
 
 See ``docs/static_analysis.md`` for the rule catalog and the baseline
 workflow.
@@ -48,8 +48,15 @@ __all__ = [
 ]
 
 
-def run_all(root: Optional[str] = None) -> Dict[str, List[Finding]]:
-    """Run every analyzer; returns findings per analyzer (pre-baseline)."""
+def run_all(
+    root: Optional[str] = None, cache: Optional[object] = None
+) -> Dict[str, List[Finding]]:
+    """Run every analyzer; returns findings per analyzer (pre-baseline).
+
+    ``cache`` — an :class:`~torchft_tpu.analysis.cache.AnalysisCache`:
+    analyzers whose input fingerprint matches replay their stored
+    findings instead of re-scanning (the CLI passes one unless
+    ``--no-cache``; programmatic callers default to uncached)."""
     from torchft_tpu.analysis import (
         concurrency,
         docdrift,
@@ -57,9 +64,19 @@ def run_all(root: Optional[str] = None) -> Dict[str, List[Finding]]:
         wiredrift,
     )
 
-    return {
-        "concurrency": concurrency.run(root),
-        "wiredrift": wiredrift.run(root),
-        "docdrift": docdrift.run(root),
-        "nativelint": nativelint.run(root),
+    runners = {
+        "concurrency": concurrency.run,
+        "wiredrift": wiredrift.run,
+        "docdrift": docdrift.run,
+        "nativelint": nativelint.run,
     }
+    out: Dict[str, List[Finding]] = {}
+    for name, runner in runners.items():
+        cached = cache.get(name) if cache is not None else None
+        if cached is not None:
+            out[name] = cached
+            continue
+        out[name] = runner(root)
+        if cache is not None:
+            cache.put(name, out[name])
+    return out

@@ -32,10 +32,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="write active findings into the baseline with "
                     "placeholder reasons (then go justify them)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the incremental analysis cache "
+                    "(.analysis_cache/) and re-scan everything")
     args = ap.parse_args(argv)
 
     try:
-        per_analyzer = run_all(args.root)
+        cache = None
+        if not args.no_cache:
+            from torchft_tpu.analysis.cache import AnalysisCache
+
+            cache = AnalysisCache(args.root)
+        per_analyzer = run_all(args.root, cache=cache)
         baseline = Baseline.load(args.baseline)
     except Exception as e:  # noqa: BLE001 — analyzer crash is exit 2
         print(f"analysis failed: {type(e).__name__}: {e}", file=sys.stderr)
@@ -83,8 +91,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"STALE    baseline entry matches nothing: {e['key']} "
                   f"(reason was: {e['reason']}) — remove it")
         if not active and not stale:
+            cached = (
+                f" [cache: {len(cache.hits)} hit(s), "
+                f"{len(cache.misses)} miss(es)]"
+                if cache is not None else ""
+            )
             print(f"clean: {len(suppressed)} baselined finding(s), "
-                  "0 active, 0 stale")
+                  f"0 active, 0 stale{cached}")
 
     return 1 if (active or stale) else 0
 

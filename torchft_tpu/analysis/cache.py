@@ -1,0 +1,137 @@
+"""Incremental analysis cache: memoize per-analyzer findings by input
+content hash (ISSUE 20 satellite).
+
+The analysis gate runs on every premerge invocation, and most of those
+runs see an unchanged tree — re-walking the runtime modules, the native
+sources and the doc catalogs to re-derive the identical findings is pure
+waste. This cache keys each analyzer's result on a fingerprint of the
+files that analyzer actually reads (plus the analyzer suite's own
+sources, so editing a RULE invalidates exactly like editing a scanned
+file), stores findings under ``.analysis_cache/`` at the repo root, and
+replays them when the fingerprint matches.
+
+Correctness is the whole game for a cache in front of a gate, so the
+input sets are deliberately conservative — over-invalidation costs one
+re-run; under-invalidation silently greenlights a regression:
+
+* ``concurrency`` — its declared ``RUNTIME_MODULES``;
+* ``wiredrift`` — every ``torchft_tpu/**/*.py`` (it walks the package
+  for ``TORCHFT_*`` env uses), every ``native/*`` source + the Makefile,
+  and every ``docs/*.md``;
+* ``docdrift`` — every ``torchft_tpu/**/*.py`` (the metric registry and
+  event catalog are built by importing the package) + ``docs/*.md`` +
+  ``scripts/premerge.sh`` (the premerge-gate-drift rule parses it);
+* ``nativelint`` — its declared ``NATIVE_GLOBS``.
+
+Every set additionally includes ``torchft_tpu/analysis/*.py``. The
+cache never touches exit-code semantics: it stores the PRE-baseline
+findings, and the baseline is applied to them exactly as to a fresh run.
+``--no-cache`` on the CLI bypasses it entirely.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
+
+from torchft_tpu.analysis.base import Finding, repo_root
+
+__all__ = ["ANALYZER_INPUTS", "AnalysisCache", "fingerprint"]
+
+CACHE_DIRNAME = ".analysis_cache"
+
+# the analyzer suite itself: a rule edit must invalidate every analyzer
+_SUITE = ("torchft_tpu/analysis/*.py",)
+
+ANALYZER_INPUTS: Dict[str, tuple] = {
+    "concurrency": ("torchft_tpu/*.py", "torchft_tpu/telemetry/*.py",
+                    "torchft_tpu/checkpointing/*.py",
+                    "torchft_tpu/faultinject/*.py") + _SUITE,
+    "wiredrift": ("torchft_tpu/**/*.py", "native/*", "docs/*.md") + _SUITE,
+    "docdrift": ("torchft_tpu/**/*.py", "docs/*.md",
+                 "scripts/premerge.sh") + _SUITE,
+    "nativelint": ("native/*.h", "native/*.cc") + _SUITE,
+}
+
+
+def fingerprint(root: str, patterns: tuple) -> str:
+    """Content hash over every file matching ``patterns`` under
+    ``root``: (relative path, size, blake2 of bytes) per file, so both
+    an edit and an add/remove change the digest."""
+    h = hashlib.blake2b(digest_size=16)
+    seen = set()
+    for pattern in patterns:
+        for path in sorted(
+            glob.glob(os.path.join(root, pattern), recursive=True)
+        ):
+            if not os.path.isfile(path) or path in seen:
+                continue
+            if "__pycache__" in path:
+                continue
+            seen.add(path)
+            rel = os.path.relpath(path, root)
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue
+            h.update(rel.encode())
+            h.update(str(len(data)).encode())
+            h.update(hashlib.blake2b(data, digest_size=16).digest())
+    return h.hexdigest()
+
+
+class AnalysisCache:
+    """Per-analyzer findings memo under ``<root>/.analysis_cache/``."""
+
+    def __init__(self, root: Optional[str] = None) -> None:
+        self.root = root or repo_root()
+        self.dir = os.path.join(self.root, CACHE_DIRNAME)
+        self.hits: List[str] = []
+        self.misses: List[str] = []
+
+    def _path(self, analyzer: str) -> str:
+        return os.path.join(self.dir, f"{analyzer}.json")
+
+    def get(self, analyzer: str) -> Optional[List[Finding]]:
+        """Cached findings when the input fingerprint matches; else
+        None. An analyzer without a declared input set never caches."""
+        patterns = ANALYZER_INPUTS.get(analyzer)
+        if patterns is None:
+            return None
+        try:
+            with open(self._path(analyzer), encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if doc.get("fingerprint") != fingerprint(self.root, patterns):
+            return None
+        try:
+            finds = [
+                Finding(e["rule"], e["path"], int(e["line"]),
+                        e["symbol"], e["message"])
+                for e in doc.get("findings", [])
+            ]
+        except (KeyError, TypeError, ValueError):
+            return None
+        self.hits.append(analyzer)
+        return finds
+
+    def put(self, analyzer: str, findings: List[Finding]) -> None:
+        patterns = ANALYZER_INPUTS.get(analyzer)
+        if patterns is None:
+            return
+        self.misses.append(analyzer)
+        os.makedirs(self.dir, exist_ok=True)
+        doc = {
+            "fingerprint": fingerprint(self.root, patterns),
+            "findings": [f.to_dict() for f in findings],
+        }
+        tmp = self._path(analyzer) + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, self._path(analyzer))

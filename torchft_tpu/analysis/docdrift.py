@@ -17,6 +17,13 @@ drift class (a thin pytest wrapper keeps them in tier-1):
     The site catalog table in ``docs/fault_injection.md`` matches
     ``faultinject.core.SITES`` exactly (new in this PR — the site list
     had no doc gate before).
+
+``premerge-gate-drift``
+    The gate-id table under "Pre-merge gates" in
+    ``docs/static_analysis.md`` matches the ``record_gate`` call sites
+    in ``scripts/premerge.sh`` exactly, both directions (ISSUE 20 —
+    the ``--json`` summary is only CI-assertable if the documented gate
+    list can't rot).
 """
 
 from __future__ import annotations
@@ -28,7 +35,7 @@ from typing import List, Optional
 from torchft_tpu.analysis.base import Finding, repo_root
 
 __all__ = ["run", "check_metric_catalog", "check_event_catalog",
-           "check_fault_sites_doc"]
+           "check_fault_sites_doc", "check_premerge_gates"]
 
 
 def _read(root: str, rel: str) -> str:
@@ -111,6 +118,52 @@ def check_fault_sites_doc(doc_text: str, sites: tuple) -> List[Finding]:
     return finds
 
 
+def check_premerge_gates(doc_text: str, script_text: str) -> List[Finding]:
+    """Bidirectional: ``record_gate "<id>"`` sites in premerge.sh vs the
+    "Pre-merge gates" table in docs/static_analysis.md."""
+    script_gates = set(re.findall(
+        r'^\s*record_gate "([a-z0-9-]+)"', script_text, re.M,
+    ))
+    finds: List[Finding] = []
+    if not script_gates:
+        return [Finding(
+            "premerge-gate-drift", "scripts/premerge.sh", 0, "<script>",
+            "no record_gate call sites found — --json summary is empty",
+        )]
+    try:
+        start = doc_text.index("### Pre-merge gates")
+    except ValueError:
+        return [Finding(
+            "premerge-gate-drift", "docs/static_analysis.md", 0, "<table>",
+            "'Pre-merge gates' section not found",
+        )]
+    section = doc_text[start:]
+    # anchor on the gate table itself (header row + separator + rows) —
+    # other tables share the section's heading level downstream
+    m = re.search(
+        r"^\| gate \|.*\n\|[-| ]+\|\n((?:\|.*\n)+)", section, re.M,
+    )
+    if m is None:
+        return [Finding(
+            "premerge-gate-drift", "docs/static_analysis.md", 0, "<table>",
+            "gate table (header '| gate |') not found under "
+            "'Pre-merge gates'",
+        )]
+    doc_gates = set(re.findall(r"^\| `([a-z0-9-]+)`", m.group(1), re.M))
+    for n in sorted(doc_gates - script_gates):
+        finds.append(Finding(
+            "premerge-gate-drift", "docs/static_analysis.md", 0, n,
+            "documented gate id has no record_gate site in "
+            "scripts/premerge.sh",
+        ))
+    for n in sorted(script_gates - doc_gates):
+        finds.append(Finding(
+            "premerge-gate-drift", "scripts/premerge.sh", 0, n,
+            "record_gate id missing from the docs 'Pre-merge gates' table",
+        ))
+    return finds
+
+
 def run(root: Optional[str] = None) -> List[Finding]:
     root = root or repo_root()
     from torchft_tpu import telemetry
@@ -119,6 +172,8 @@ def run(root: Optional[str] = None) -> List[Finding]:
 
     obs = _read(root, "docs/observability.md")
     fi = _read(root, "docs/fault_injection.md")
+    sa = _read(root, "docs/static_analysis.md")
+    premerge = _read(root, os.path.join("scripts", "premerge.sh"))
     registry_names = {
         name for name in telemetry.REGISTRY.dump() if name.startswith("tft_")
     }
@@ -126,4 +181,5 @@ def run(root: Optional[str] = None) -> List[Finding]:
     out += check_metric_catalog(obs, registry_names)
     out += check_event_catalog(obs, CANONICAL_EVENTS)
     out += check_fault_sites_doc(fi, SITES)
+    out += check_premerge_gates(sa, premerge)
     return out

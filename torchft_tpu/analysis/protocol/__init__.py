@@ -28,15 +28,23 @@ under DIR). See ``docs/static_analysis.md`` "Protocol verification".
 """
 
 from torchft_tpu.analysis.protocol.spec import (
+    CANDIDATE,
     DEAD,
+    FOLLOWER,
     HEALING,
     HEALTHY,
     JOINING,
+    LEADER,
     SPECULATING,
     Invariant,
     SpecConfig,
 )
-from torchft_tpu.analysis.protocol.checker import CheckResult, check
+from torchft_tpu.analysis.protocol.checker import (
+    GATE_CONFIGS,
+    HA_STATE_BUDGETS,
+    CheckResult,
+    check,
+)
 from torchft_tpu.analysis.protocol.conformance import (
     check_records,
     check_trail_file,
@@ -49,10 +57,15 @@ __all__ = [
     "HEALING",
     "SPECULATING",
     "DEAD",
+    "FOLLOWER",
+    "CANDIDATE",
+    "LEADER",
     "Invariant",
     "SpecConfig",
     "CheckResult",
     "check",
+    "GATE_CONFIGS",
+    "HA_STATE_BUDGETS",
     "check_records",
     "check_trail_file",
     "check_tree",

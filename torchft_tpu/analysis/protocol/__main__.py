@@ -21,7 +21,11 @@ import sys
 import time
 from typing import List, Optional
 
-from torchft_tpu.analysis.protocol.checker import GATE_CONFIGS, check
+from torchft_tpu.analysis.protocol.checker import (
+    GATE_CONFIGS,
+    HA_STATE_BUDGETS,
+    check,
+)
 from torchft_tpu.analysis.protocol.conformance import check_tree
 
 
@@ -40,6 +44,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="model-check only these gate configs")
     ap.add_argument("--skip-model", action="store_true",
                     help="conformance replay only")
+    ap.add_argument("--no-por", action="store_true",
+                    help="disable partial-order reduction (exhaustive "
+                    "reference mode half 1)")
+    ap.add_argument("--no-symmetry", action="store_true",
+                    help="disable symmetry reduction (reference half 2)")
+    ap.add_argument("--bitstate", action="store_true",
+                    help="64-bit bitstate hashing: cheaper visited set, "
+                    "APPROXIMATE coverage — never a gate verdict")
+    ap.add_argument("--max-states", type=int, default=None,
+                    help="override the per-config state budget")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
@@ -49,16 +63,29 @@ def main(argv: Optional[List[str]] = None) -> int:
             names = args.config or sorted(GATE_CONFIGS)
             for name in names:
                 t0 = time.time()
-                res = check(GATE_CONFIGS[name])
+                budget = args.max_states or HA_STATE_BUDGETS.get(
+                    name, 2_000_000
+                )
+                res = check(
+                    GATE_CONFIGS[name],
+                    max_states=budget,
+                    por=not args.no_por,
+                    symmetry=not args.no_symmetry,
+                    bitstate=args.bitstate,
+                )
                 report["model"][name] = {
                     "states": res.states,
                     "transitions": res.transitions,
+                    "budget": budget,
                     "violations": [
                         {"invariant": v.invariant, "detail": v.detail,
                          "trace": v.trace}
                         for v in res.violations
                     ],
                     "truncated": res.truncated,
+                    "truncated_states": res.truncated_states,
+                    "truncated_transitions": res.truncated_transitions,
+                    "approximate": res.approximate,
                     "seconds": round(time.time() - t0, 2),
                 }
                 if not args.as_json:
@@ -68,6 +95,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"{len(res.violations)} violation(s) "
                         f"[{report['model'][name]['seconds']}s]"
                     )
+                    if res.truncated:
+                        print(
+                            f"  TRUNCATED: budget {budget} hit — "
+                            f"{res.truncated_states} frontier state(s) "
+                            f"and {res.truncated_transitions} enabled "
+                            "action(s) never explored; NOT a clean "
+                            "verdict"
+                        )
+                    if res.approximate:
+                        print(
+                            "  APPROXIMATE: bitstate hashing on — a "
+                            "hash collision silently prunes coverage; "
+                            "exploratory only, never a gate verdict"
+                        )
                     for v in res.violations:
                         print("  " + v.render())
                 report["ok"] = report["ok"] and res.ok

@@ -49,6 +49,45 @@ Invariants (``check_state`` / ``check_terminal``):
   (PR 10);
 * ``L  liveness``        — in every terminal state with at least
   ``min_replicas`` live replicas, some step committed.
+
+**The HA layer (ISSUE 20).** With ``n_lighthouses >= 2`` the model grows
+a Raft-replicated lighthouse tier: each lighthouse replica is FOLLOWER /
+CANDIDATE / LEADER / DEAD with a term, a single persistent vote per
+term, and a durable log of quorum *decisions* (one ``(term, rid)`` entry
+appended by the leader that forms round ``rid``). Leaders commit a log
+prefix once a majority of lighthouses replicated it; managers fail over
+via the peer list (``form`` goes through *any* live leader — including a
+stale minority-partitioned one, which is exactly the hazard the
+majority-commit fence neutralizes). ``membership_deltas`` adds the
+sublinear-control-traffic membership protocol: the lighthouse keeps a
+versioned membership log, replicas apply deltas in order (a gap forces a
+full-snapshot resync), and rounds stamp the membership version their
+quorum was computed against. ``n_subaggs`` adds the two-level quorum
+tree: sub-aggregator nodes front the joins of the groups they own; a
+sub-aggregator crash loses its buffered joins (the members re-join
+through a re-homed aggregator) but never touches a formed round.
+
+HA invariants:
+
+* ``H1 one-leader-per-term``  — no two live leaders share a term
+  (election safety; ``raft_single_vote=False`` plants the double-vote
+  bug that breaks it);
+* ``H2 committed-survives``   — every decision ever majority-committed
+  is present in every live leader's log (leader-death durability;
+  ``stale_leader_fence=False`` lets a minority leader commit locally
+  and breaks it);
+* ``H3 stale-view-commit``    — no commit vote rides a membership view
+  older than the round's (``stale_view_fence=False`` breaks it);
+* ``H4 delta-chain``          — a replica's incrementally-applied view
+  always equals the full snapshot at its version
+  (``ordered_deltas=False`` applies deltas out of order and breaks it);
+* ``H5 epoch-unique``         — formed rounds carry globally unique
+  epochs: a sub-aggregator crash/re-home never splits a group's epoch.
+
+Election *liveness* is deliberately out of scope: Raft terminates
+elections with randomized timeouts, which a bounded nondeterministic
+model cannot honor — terminal states with no live leader are exempt from
+``L`` (the checker proves election safety, not election progress).
 """
 
 from __future__ import annotations
@@ -58,7 +97,9 @@ from typing import FrozenSet, List, NamedTuple, Optional, Tuple
 
 __all__ = [
     "JOINING", "HEALTHY", "HEALING", "SPECULATING", "DEAD",
+    "FOLLOWER", "CANDIDATE", "LEADER",
     "SpecConfig", "Replica", "Round", "State", "Invariant",
+    "Lighthouse", "Subagg",
     "init_state", "enabled_actions", "check_state", "check_terminal",
     "is_terminal",
 ]
@@ -70,6 +111,11 @@ HEALTHY = "HEALTHY"
 HEALING = "HEALING"
 SPECULATING = "SPECULATING"
 DEAD = "DEAD"
+
+# lighthouse replica (Raft) status values — DEAD is shared
+FOLLOWER = "FOLLOWER"
+CANDIDATE = "CANDIDATE"
+LEADER = "LEADER"
 
 
 @dataclass(frozen=True)
@@ -94,6 +140,22 @@ class SpecConfig:
     fence_divergence: bool = True    # PR 10: mismatched digests veto
     rollback_residual: bool = True   # PR 6: veto rolls residual back
 
+    # --- the HA layer (ISSUE 20) — all off/neutral by default, so the
+    # single-lighthouse configurations above explore the exact PR 15
+    # state space
+    n_lighthouses: int = 1       # >= 2 arms the Raft lighthouse tier
+    lh_crash_budget: int = 0     # lighthouse SIGKILLs (durable log kept)
+    lh_respawn_budget: int = 0
+    max_terms: int = 1           # term ids 1..max_terms bound elections
+    partition_budget: int = 0    # isolate-the-leader network splits
+    raft_single_vote: bool = True    # False = double-vote split brain
+    stale_leader_fence: bool = True  # False = minority leader commits
+    membership_deltas: bool = False  # versioned membership delta stream
+    ordered_deltas: bool = True      # False = deltas applied out of order
+    stale_view_fence: bool = True    # False = commit on a stale view
+    n_subaggs: int = 0           # two-level quorum tree fan-in nodes
+    subagg_crash_budget: int = 0
+
 
 class Replica(NamedTuple):
     status: str
@@ -111,6 +173,8 @@ class Replica(NamedTuple):
     spec_round: int           # round id of the in-flight speculative vote
     spec_token: str           # provisional token (speculation)
     epoch: int                # last quorum epoch observed
+    mview: int = 0            # membership version this replica applied
+    view: FrozenSet[int] = frozenset()  # its membership view at mview
 
 
 class Round(NamedTuple):
@@ -126,6 +190,36 @@ class Round(NamedTuple):
     # later crash — a peer that died AFTER contributing does not fail
     # the survivors' allreduce, and their commits are per-group.
     done: FrozenSet[int]
+    mver: int = 0   # membership version the quorum was computed against
+
+
+class Lighthouse(NamedTuple):
+    """One lighthouse replica of the Raft tier (``n_lighthouses >= 2``).
+
+    ``term``/``voted_for``/``log`` are *durable* (they survive a crash —
+    Raft's persistent state); ``votes`` (the ballots a candidate
+    gathered) is volatile. ``log`` holds quorum-decision entries
+    ``(term, rid)``; ``commit_len`` is the majority-replicated prefix
+    this node, as leader, has committed. ``cell`` is the partition cell
+    (0 = the majority side)."""
+
+    status: str
+    term: int
+    voted_for: int                       # -1 = no vote cast this term
+    votes: FrozenSet[int]
+    log: Tuple[Tuple[int, int], ...]
+    commit_len: int
+    cell: int
+
+
+class Subagg(NamedTuple):
+    """A sub-aggregator of the two-level quorum tree: fronts the joins
+    of the replica groups it ``owns``. Its only protocol state is the
+    buffered joins — a crash loses those (the members re-join through a
+    re-homed aggregator) and nothing else."""
+
+    status: str                          # HEALTHY / DEAD
+    owns: FrozenSet[int]
 
 
 class State(NamedTuple):
@@ -140,6 +234,23 @@ class State(NamedTuple):
     # committed tokens per step, fleet-wide: ((step, (tokens...)), ...)
     commits: Tuple[Tuple[int, Tuple[str, ...]], ...]
     divergence_latched: bool
+    # --- HA layer (constant () / 0 in single-lighthouse configs, so
+    # the PR 15 state space is unchanged byte for byte)
+    lighthouses: Tuple[Lighthouse, ...] = ()
+    # every decision entry ever majority-committed, fleet-global ledger
+    # (the H2 durability oracle — the model's ghost variable):
+    # (commit_term, entry_term, rid) — commit_term scopes the Raft
+    # Leader Completeness claim (a STALE lower-term leader legally
+    # lacks entries committed after its term; it can't commit anything)
+    ha_committed: Tuple[Tuple[int, int, int], ...] = ()
+    lh_crash_budget: int = 0
+    lh_respawn_budget: int = 0
+    partition_budget: int = 0
+    mversion: int = 0                       # membership log head version
+    # membership deltas: (version, replica, alive) — version is 1-based
+    mlog: Tuple[Tuple[int, int, bool], ...] = ()
+    subaggs: Tuple[Subagg, ...] = ()
+    subagg_budget: int = 0
 
 
 class Invariant(NamedTuple):
@@ -150,6 +261,32 @@ class Invariant(NamedTuple):
 
 
 def init_state(cfg: SpecConfig) -> State:
+    full_view = (
+        frozenset(range(cfg.n_replicas))
+        if cfg.membership_deltas else frozenset()
+    )
+    lighthouses: Tuple[Lighthouse, ...] = ()
+    if cfg.n_lighthouses >= 2:
+        # boot with lighthouse 0 already elected at term 1 (every peer
+        # voted for it) — the interesting space is what happens AFTER
+        # the steady state, not the bootstrap election
+        lighthouses = tuple(
+            Lighthouse(
+                status=(LEADER if i == 0 else FOLLOWER), term=1,
+                voted_for=0, votes=frozenset(), log=(), commit_len=0,
+                cell=0,
+            )
+            for i in range(cfg.n_lighthouses)
+        )
+    subaggs: Tuple[Subagg, ...] = ()
+    if cfg.n_subaggs > 0:
+        subaggs = tuple(
+            Subagg(status=HEALTHY, owns=frozenset(
+                i for i in range(cfg.n_replicas)
+                if i % cfg.n_subaggs == s
+            ))
+            for s in range(cfg.n_subaggs)
+        )
     return State(
         replicas=tuple(
             Replica(
@@ -157,6 +294,7 @@ def init_state(cfg: SpecConfig) -> State:
                 joined=False, round=-1, voted=False, abstain=False,
                 worked=False, diverged=False, healer=False, healed=False,
                 spec_round=-1, spec_token="", epoch=-1,
+                mview=0, view=full_view,
             )
             for _ in range(cfg.n_replicas)
         ),
@@ -165,6 +303,12 @@ def init_state(cfg: SpecConfig) -> State:
         respawn_budget=cfg.respawn_budget,
         corrupt_budget=cfg.corrupt_budget,
         commits=(), divergence_latched=False,
+        lighthouses=lighthouses,
+        lh_crash_budget=cfg.lh_crash_budget,
+        lh_respawn_budget=cfg.lh_respawn_budget,
+        partition_budget=cfg.partition_budget,
+        subaggs=subaggs,
+        subagg_budget=cfg.subagg_crash_budget,
     )
 
 
@@ -220,6 +364,76 @@ def _attached(state: State, rnd: Round, j: int) -> bool:
     return r.round == rnd.rid or r.spec_round == rnd.rid
 
 
+# --- HA helpers ------------------------------------------------------------
+
+
+def _lh_majority(cfg: SpecConfig) -> int:
+    return cfg.n_lighthouses // 2 + 1
+
+
+def _lh_live(state: State) -> List[int]:
+    return [
+        i for i, lh in enumerate(state.lighthouses) if lh.status != DEAD
+    ]
+
+
+def _live_leaders(state: State) -> List[int]:
+    return [
+        i for i, lh in enumerate(state.lighthouses)
+        if lh.status == LEADER
+    ]
+
+
+def _set_lh(state: State, idx: int, lh: Lighthouse, **kw) -> State:
+    lhs = state.lighthouses[:idx] + (lh,) + state.lighthouses[idx + 1:]
+    return state._replace(lighthouses=lhs, **kw)
+
+
+def _log_up_to_date(
+    a: Tuple[Tuple[int, int], ...], b: Tuple[Tuple[int, int], ...]
+) -> bool:
+    """Raft §5.4.1: is log ``a`` at least as up-to-date as ``b``?
+    (compare last entry's term, then length)"""
+    la = a[-1][0] if a else 0
+    lb = b[-1][0] if b else 0
+    return la > lb or (la == lb and len(a) >= len(b))
+
+
+def _mem_snapshot(
+    mlog: Tuple[Tuple[int, int, bool], ...], version: int, n: int
+) -> FrozenSet[int]:
+    """The full membership snapshot at ``version``: the initial full
+    set with every delta up to and including ``version`` applied in
+    order — the reference the delta chain must be equivalent to."""
+    view = set(range(n))
+    for ver, rep, alive in mlog:
+        if ver > version:
+            break
+        if alive:
+            view.add(rep)
+        else:
+            view.discard(rep)
+    return frozenset(view)
+
+
+def _mem_bump(state: State, cfg: SpecConfig, rep: int,
+              alive: bool) -> dict:
+    """State-field updates for one membership change (crash/respawn of
+    replica ``rep``): bump the version, append the delta."""
+    if not cfg.membership_deltas:
+        return {}
+    v = state.mversion + 1
+    return {"mversion": v, "mlog": state.mlog + ((v, rep, alive),)}
+
+
+def _home(state: State, i: int) -> Optional[int]:
+    """The sub-aggregator owning replica ``i`` (None = no tree)."""
+    for s, sub in enumerate(state.subaggs):
+        if i in sub.owns:
+            return s
+    return None
+
+
 def enabled_actions(
     state: State, cfg: SpecConfig
 ) -> List[Tuple[str, State]]:
@@ -246,6 +460,9 @@ def enabled_actions(
                 state, i, dead,
                 open_round=state.open_round - {i},
                 crash_budget=state.crash_budget - 1,
+                # a death is a membership change: the lighthouse bumps
+                # the membership version and appends the delta
+                **_mem_bump(state, cfg, i, alive=False),
             )
             out.append((f"crash({i})", ns))
 
@@ -254,9 +471,23 @@ def enabled_actions(
         for i, r in enumerate(state.replicas):
             if r.status != DEAD:
                 continue
+            bump = _mem_bump(state, cfg, i, alive=True)
+            rep = r._replace(status=JOINING)
+            if cfg.membership_deltas:
+                # a (re)join hands the replica the FULL membership
+                # snapshot (the sublinear protocol's bootstrap path) —
+                # deltas only flow to already-synced members
+                v = bump["mversion"]
+                rep = rep._replace(
+                    mview=v,
+                    view=_mem_snapshot(
+                        bump["mlog"], v, cfg.n_replicas
+                    ),
+                )
             ns = _replace(
-                state, i, r._replace(status=JOINING),
+                state, i, rep,
                 respawn_budget=state.respawn_budget - 1,
+                **bump,
             )
             out.append((f"respawn({i})", ns))
 
@@ -266,6 +497,12 @@ def enabled_actions(
             r = state.replicas[i]
             if r.joined or r.round >= 0:
                 continue
+            if state.subaggs:
+                # two-level tree: the join goes through the replica's
+                # sub-aggregator; a dead home blocks it until re-home
+                home = _home(state, i)
+                if home is None or state.subaggs[home].status == DEAD:
+                    continue
             # pipelined: a replica may join the next round while its
             # previous vote is still in flight — that IS the pipeline
             ns = _replace(
@@ -308,13 +545,32 @@ def enabled_actions(
                 rounds=state.rounds + (
                     Round(rid=rid, epoch=epoch, step=max_step,
                           members=joined, votes=(),
-                          resolved=frozenset(), done=frozenset()),
+                          resolved=frozenset(), done=frozenset(),
+                          mver=state.mversion),
                 ),
                 open_round=frozenset(),
                 epoch=epoch,
                 rounds_formed=rid + 1,
             )
-            out.append((f"form(r{rid},step={max_step})", ns))
+            if not state.lighthouses:
+                out.append((f"form(r{rid},step={max_step})", ns))
+            else:
+                # HA tier: the round is a quorum DECISION — it must go
+                # through a leader, which appends the (term, rid) entry
+                # to its durable log. Managers fail over via the peer
+                # list, so ANY live leader serves — including a stale
+                # minority-partitioned one (its appended entry can never
+                # majority-commit while the fence holds; with the fence
+                # off that is exactly the H2 counterexample).
+                for li in _live_leaders(state):
+                    lh = ns.lighthouses[li]
+                    ns2 = _set_lh(
+                        ns, li,
+                        lh._replace(log=lh.log + ((lh.term, rid),)),
+                    )
+                    out.append(
+                        (f"form(r{rid},step={max_step},lh={li})", ns2)
+                    )
 
     # per-round member actions
     for rnd in state.rounds:
@@ -408,10 +664,23 @@ def enabled_actions(
             # rolled-back step inside a round labeled one ahead
             # (manager.py start_quorum's "a veto makes that step's
             # label one ahead" comment).
-            if r.round == rnd.rid and r.worked and not r.voted:
+            # a commit vote must ride a membership view at least as new
+            # as the one the round's quorum was computed against: with
+            # the fence on, a lagging replica applies its pending deltas
+            # (or snapshot-resyncs) before voting — the action is
+            # disabled, not taken; with the fence off the vote goes out
+            # stale and H3 flags it (the !stale label)
+            stale_view = (
+                cfg.membership_deltas and r.mview < rnd.mver
+            )
+            if (
+                r.round == rnd.rid and r.worked and not r.voted
+                and not (stale_view and cfg.stale_view_fence)
+            ):
                 token = _token(
                     r.step, r.diverged and not r.healer, rnd.epoch
                 )
+                tag = "!stale" if stale_view else ""
                 if cfg.speculation and not r.healer:
                     # pipelined: apply the update provisionally, vote,
                     # and float free to start the next step while the
@@ -426,13 +695,13 @@ def enabled_actions(
                     ns = _set_round(
                         ns, rnd._replace(votes=rnd.votes + ((i, token),))
                     )
-                    out.append((f"vote_spec({i})", ns))
+                    out.append((f"vote_spec({i}){tag}", ns))
                 else:
                     ns = _replace(state, i, r._replace(voted=True))
                     ns = _set_round(
                         ns, rnd._replace(votes=rnd.votes + ((i, token),))
                     )
-                    out.append((f"vote({i})", ns))
+                    out.append((f"vote({i}){tag}", ns))
 
             # -- resolve: this replica's vote decision lands. Commit is
             # arbitrated PER replica group; the divergence fence is the
@@ -455,7 +724,238 @@ def enabled_actions(
                     continue  # fence: wait for the full cohort's digests
                 out.append(_resolve(state, cfg, rnd, i))
 
+    _ha_actions(state, cfg, out)
     return out
+
+
+def _ha_actions(
+    state: State, cfg: SpecConfig, out: List[Tuple[str, State]]
+) -> None:
+    """The HA-layer transitions: Raft lighthouse tier, membership
+    deltas, sub-aggregator tree. All empty in a default config."""
+
+    # ---- Raft lighthouse tier -------------------------------------------
+    for li, lh in enumerate(state.lighthouses):
+        if lh.status == DEAD:
+            # -- lh_respawn: durable state (term/voted_for/log) intact,
+            # volatile ballots gone; returns as a follower
+            if state.lh_respawn_budget > 0:
+                ns = _set_lh(
+                    state, li,
+                    lh._replace(status=FOLLOWER, votes=frozenset()),
+                    lh_respawn_budget=state.lh_respawn_budget - 1,
+                )
+                out.append((f"lh_respawn({li})", ns))
+            continue
+
+        # -- lh_crash: SIGKILL a lighthouse; the log is durable
+        if state.lh_crash_budget > 0:
+            ns = _set_lh(
+                state, li,
+                lh._replace(status=DEAD, votes=frozenset()),
+                lh_crash_budget=state.lh_crash_budget - 1,
+            )
+            out.append((f"lh_crash({li})", ns))
+
+        # -- lh_campaign: a non-leader that sees no live leader in its
+        # cell at its term or above starts an election one term up
+        # (bounded by max_terms — election *liveness* is randomized-
+        # timeout territory, out of the model's scope)
+        if lh.status != LEADER and lh.term + 1 <= cfg.max_terms:
+            leader_visible = any(
+                o.status == LEADER and o.cell == lh.cell
+                and o.term >= lh.term
+                for oi, o in enumerate(state.lighthouses)
+                if oi != li and o.status != DEAD
+            )
+            if not leader_visible:
+                ns = _set_lh(state, li, lh._replace(
+                    status=CANDIDATE, term=lh.term + 1, voted_for=li,
+                    votes=frozenset({li}),
+                ))
+                out.append((f"lh_campaign({li},t{lh.term + 1})", ns))
+
+        # -- lh_vote: grant a ballot to a live same-cell candidate.
+        # Raft's two checks: one vote per term (persistent voted_for),
+        # and the candidate's log must be at least as up-to-date.
+        # ``raft_single_vote=False`` plants the double-vote bug.
+        if lh.status == CANDIDATE:
+            for vi, v in enumerate(state.lighthouses):
+                if (
+                    vi == li or v.status == DEAD or v.cell != lh.cell
+                    or lh.term < v.term
+                ):
+                    continue
+                already = v.voted_for >= 0 and v.term == lh.term
+                if already and v.voted_for != li and cfg.raft_single_vote:
+                    continue
+                if v.voted_for == li and v.term == lh.term:
+                    continue  # ballot already counted
+                if not _log_up_to_date(lh.log, v.log):
+                    continue
+                granter = v._replace(
+                    status=(FOLLOWER if v.status != DEAD else v.status),
+                    term=lh.term, voted_for=li, votes=frozenset(),
+                )
+                ns = _set_lh(state, vi, granter)
+                ns = _set_lh(
+                    ns, li,
+                    ns.lighthouses[li]._replace(
+                        votes=lh.votes | {vi}
+                    ),
+                )
+                out.append((f"lh_vote({vi}->{li},t{lh.term})", ns))
+
+        # -- lh_elect: a candidate with a majority of ballots wins
+        if (
+            lh.status == CANDIDATE
+            and len(lh.votes) >= _lh_majority(cfg)
+        ):
+            ns = _set_lh(state, li, lh._replace(status=LEADER))
+            out.append((f"lh_elect({li},t{lh.term})", ns))
+
+        # -- lh_append: a leader replicates its log to a live same-cell
+        # peer at or below its term (full-prefix adoption — the
+        # AppendEntries catch-up collapsed to one step; a stale leader
+        # adopting a newer leader's log is Raft's log repair and steps
+        # it down)
+        if lh.status == LEADER:
+            for fi, f in enumerate(state.lighthouses):
+                if (
+                    fi == li or f.status == DEAD or f.cell != lh.cell
+                    or f.term > lh.term or f.log == lh.log
+                ):
+                    continue
+                ns = _set_lh(state, fi, f._replace(
+                    status=FOLLOWER, term=lh.term, log=lh.log,
+                    votes=frozenset(),
+                ))
+                out.append((f"lh_append({fi}<-{li})", ns))
+
+        # -- lh_commit: the leader advances its commit index over the
+        # longest prefix a majority of lighthouses hold (logs are
+        # durable, so a dead node's replicated prefix still counts).
+        # ``stale_leader_fence=False`` plants the bug: the leader
+        # commits its whole log with no majority check — a minority-
+        # partitioned stale leader then "commits" decisions the next
+        # leader never saw (H2).
+        if lh.status == LEADER and lh.commit_len < len(lh.log):
+            if cfg.stale_leader_fence:
+                new_len = lh.commit_len
+                for k in range(lh.commit_len + 1, len(lh.log) + 1):
+                    holders = sum(
+                        1 for o in state.lighthouses
+                        if o.log[:k] == lh.log[:k]
+                    )
+                    if holders >= _lh_majority(cfg):
+                        new_len = k
+                    else:
+                        break
+            else:
+                new_len = len(lh.log)
+            if new_len > lh.commit_len:
+                known = {
+                    (et, rid) for _ct, et, rid in state.ha_committed
+                }
+                committed = state.ha_committed + tuple(
+                    (lh.term, e[0], e[1])
+                    for e in lh.log[lh.commit_len:new_len]
+                    if e not in known
+                )
+                ns = _set_lh(
+                    state, li, lh._replace(commit_len=new_len),
+                    ha_committed=committed,
+                )
+                out.append((f"lh_commit({li},{new_len})", ns))
+
+    # -- lh_partition / lh_unpartition: a network split that isolates
+    # the current leader (the classic stale-leader scenario); healing
+    # restores one cell
+    if state.partition_budget > 0 and len(state.lighthouses) >= 3:
+        for li in _live_leaders(state):
+            if state.lighthouses[li].cell != 0:
+                continue
+            ns = _set_lh(
+                state, li,
+                state.lighthouses[li]._replace(cell=1),
+                partition_budget=state.partition_budget - 1,
+            )
+            out.append((f"lh_partition({li})", ns))
+    if any(lh.cell != 0 for lh in state.lighthouses):
+        ns = state._replace(lighthouses=tuple(
+            lh._replace(cell=0) for lh in state.lighthouses
+        ))
+        out.append(("lh_unpartition", ns))
+
+    # ---- membership deltas ----------------------------------------------
+    if cfg.membership_deltas and state.mversion > 0:
+        for i, r in enumerate(state.replicas):
+            if r.status == DEAD or r.mview >= state.mversion:
+                continue
+            if cfg.ordered_deltas:
+                versions = (r.mview + 1,)
+            else:
+                # the planted bug: the transport reorders/drops, and the
+                # replica applies whatever delta arrives next
+                versions = tuple(
+                    range(r.mview + 1, state.mversion + 1)
+                )
+            for v in versions:
+                ver, rep, alive = state.mlog[v - 1]
+                view = (r.view | {rep}) if alive else (r.view - {rep})
+                ns = _replace(
+                    state, i, r._replace(mview=v, view=view)
+                )
+                out.append((f"delta({i},v{v})", ns))
+            if state.mversion - r.mview >= 2:
+                # gap detected (a delta was lost): the sublinear
+                # protocol falls back to the full snapshot
+                ns = _replace(state, i, r._replace(
+                    mview=state.mversion,
+                    view=_mem_snapshot(
+                        state.mlog, state.mversion, cfg.n_replicas
+                    ),
+                ))
+                out.append((f"delta_snap({i})", ns))
+
+    # ---- sub-aggregator tree --------------------------------------------
+    if state.subaggs:
+        live_subs = [
+            s for s, sub in enumerate(state.subaggs)
+            if sub.status != DEAD
+        ]
+        # -- sub_crash: the aggregator dies; its buffered (un-formed)
+        # joins die with it — the owned members fall out of the open
+        # round and must re-join once re-homed. Formed rounds are
+        # untouched: the tree only fronts joins (H5's contract).
+        if state.subagg_budget > 0 and len(live_subs) > 1:
+            for s in live_subs:
+                sub = state.subaggs[s]
+                reps = tuple(
+                    r._replace(joined=False) if i in sub.owns else r
+                    for i, r in enumerate(state.replicas)
+                )
+                ns = state._replace(
+                    replicas=reps,
+                    open_round=state.open_round - sub.owns,
+                    subaggs=tuple(
+                        x._replace(status=DEAD) if j == s else x
+                        for j, x in enumerate(state.subaggs)
+                    ),
+                    subagg_budget=state.subagg_budget - 1,
+                )
+                out.append((f"sub_crash({s})", ns))
+        # -- sub_rehome: a dead aggregator's groups re-home onto the
+        # first live one (deterministic — the lighthouse assigns)
+        for s, sub in enumerate(state.subaggs):
+            if sub.status != DEAD or not sub.owns or not live_subs:
+                continue
+            t = live_subs[0]
+            subs = list(state.subaggs)
+            subs[t] = subs[t]._replace(owns=subs[t].owns | sub.owns)
+            subs[s] = sub._replace(owns=frozenset())
+            ns = state._replace(subaggs=tuple(subs))
+            out.append((f"sub_rehome({s}->{t})", ns))
 
 
 def _resolve(
@@ -617,6 +1117,80 @@ def check_state(
                 f"lighthouse's {state.epoch}",
             ))
 
+    # ---- HA invariants (ISSUE 20) --------------------------------------
+
+    # H1: at most one live leader per term (Raft election safety)
+    if state.lighthouses:
+        by_term: dict = {}
+        for li, lh in enumerate(state.lighthouses):
+            if lh.status == LEADER:
+                by_term.setdefault(lh.term, []).append(li)
+        for term, leaders in sorted(by_term.items()):
+            if len(leaders) > 1:
+                out.append(Invariant(
+                    "H1-one-leader-per-term",
+                    f"term {term} has {len(leaders)} live leaders "
+                    f"{leaders} — split-brain election (a voter granted "
+                    "two ballots in one term)",
+                ))
+
+        # H2: Raft Leader Completeness over quorum decisions — a
+        # decision committed in term T must be present in every live
+        # leader of term >= T (a STALE lower-term leader legally lacks
+        # newer entries; the majority-commit fence keeps it impotent)
+        for li in _live_leaders(state):
+            lh = state.lighthouses[li]
+            for ct, et, rid in state.ha_committed:
+                if lh.term >= ct and (et, rid) not in lh.log:
+                    out.append(Invariant(
+                        "H2-committed-survives",
+                        f"leader {li} (term {lh.term}) is missing "
+                        f"decision ({et}, r{rid}) committed in term "
+                        f"{ct} — a committed quorum decision was lost "
+                        "across a leader change (stale-leader commit)",
+                    ))
+
+    # H3: a commit vote rode a membership view older than the round's
+    # (action-labelled, like I3 — the !stale tag marks the transition)
+    if action.startswith(("vote(", "vote_spec(")) \
+            and action.endswith("!stale"):
+        out.append(Invariant(
+            "H3-stale-view-commit",
+            f"{action}: the commit vote rode a membership view older "
+            "than the version the round's quorum was computed against "
+            "— the stale-view fence must hold the vote until the "
+            "replica's deltas catch up",
+        ))
+
+    # H4: delta-chain equivalence — the incrementally-applied view must
+    # equal the full snapshot at the replica's version
+    if cfg.membership_deltas:
+        for i, r in enumerate(state.replicas):
+            if r.status == DEAD:
+                continue
+            want = _mem_snapshot(state.mlog, r.mview, cfg.n_replicas)
+            if r.view != want:
+                out.append(Invariant(
+                    "H4-delta-chain",
+                    f"replica {i} at membership v{r.mview} holds view "
+                    f"{sorted(r.view)} but the snapshot at v{r.mview} "
+                    f"is {sorted(want)} — the delta stream was applied "
+                    "out of order (delta-chain equivalence broken)",
+                ))
+
+    # H5: formed rounds carry globally unique epochs — a sub-aggregator
+    # crash/re-home must never split a group's epoch plane
+    seen_epochs: dict = {}
+    for rnd in state.rounds:
+        if rnd.epoch in seen_epochs:
+            out.append(Invariant(
+                "H5-epoch-unique",
+                f"rounds r{seen_epochs[rnd.epoch]} and r{rnd.rid} both "
+                f"carry epoch {rnd.epoch} — the epoch plane split",
+            ))
+        else:
+            seen_epochs[rnd.epoch] = rnd.rid
+
     return out
 
 
@@ -628,6 +1202,14 @@ def check_terminal(state: State, cfg: SpecConfig) -> List[Invariant]:
     """Liveness-ish: a terminal state with a quorum's worth of live
     replicas must have committed something."""
     live = _live(state)
+    if state.lighthouses and not _live_leaders(state):
+        # no live leader in a terminal state: the election deadlocked
+        # inside the term bound (two candidates splitting the vote
+        # forever). Raft breaks these with randomized timeouts — a
+        # probabilistic liveness argument a bounded nondeterministic
+        # model cannot make, so these terminals are exempt from L (the
+        # checker proves election SAFETY, not election progress).
+        return []
     if len(live) >= cfg.min_replicas and cfg.max_rounds > 0:
         if not state.commits:
             return [Invariant(

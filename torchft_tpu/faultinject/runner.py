@@ -370,6 +370,38 @@ class Result:
     checksums: Optional[List[str]] = None
 
 
+# descriptors compiled from model-checker traces by
+# `python -m torchft_tpu.analysis.protocol.compile` (ISSUE 20): the
+# bare `--compiled` flag replays this checked-in set
+COMPILED_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "compiled")
+
+
+def load_compiled_scenarios(compiled_dir: str) -> List[Scenario]:
+    """Compiled-schedule descriptors → scenarios. Non-runnable
+    descriptors (unlowered HA coordinates awaiting the Raft wiring) are
+    skipped loudly — silently dropping them would read as coverage."""
+    out: List[Scenario] = []
+    for path in sorted(glob.glob(os.path.join(compiled_dir, "*.json"))):
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if not doc.get("runnable"):
+            print(f"--- {doc.get('name', path)}: SKIPPED (not runnable: "
+                  f"{len(doc.get('unlowered', []))} unlowered HA "
+                  "action(s) — pending the Raft wiring)")
+            continue
+        out.append(Scenario(
+            name=doc["name"],
+            description=doc.get("description", ""),
+            common_env=dict(doc.get("common_env", {})),
+            victim_schedule=doc.get("victim_schedule"),
+            survivor_schedule=doc.get("survivor_schedule"),
+            expect_victim_death=bool(doc.get("expect_victim_death")),
+            quick=False,
+        ))
+    return out
+
+
 def _env_signature(text: str) -> Optional[str]:
     for sig in ENV_CORRUPTION_SIGNATURES:
         if sig in text:
@@ -2007,6 +2039,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     choices=("asan", "tsan"), metavar="{asan,tsan}",
                     help="rebuild the native plane under the named "
                     "sanitizer (default asan) and fail on any report")
+    ap.add_argument("--compiled", nargs="?", const=COMPILED_DIR,
+                    default=None, metavar="DIR",
+                    help="also run the compiled-schedule descriptors "
+                    "under DIR (default: the shipped faultinject/"
+                    "compiled set from the model checker); with no "
+                    "--scenario/--quick, runs ONLY those")
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-scenario wall-clock cap (seconds)")
@@ -2025,15 +2063,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     os.makedirs(outdir, exist_ok=True)
     steps = args.steps or (10 if (args.quick or args.sanitize) else 16)
 
+    compiled = (
+        load_compiled_scenarios(args.compiled) if args.compiled else []
+    )
     selected = SCENARIOS
     if args.scenario:
         by_name = {s.name: s for s in SCENARIOS}
+        by_name.update({s.name: s for s in compiled})
         unknown = [n for n in args.scenario if n not in by_name]
         if unknown:
             ap.error(f"unknown scenario(s) {unknown}; see --list")
         selected = [by_name[n] for n in args.scenario]
     elif args.quick or args.sanitize:
-        selected = [s for s in SCENARIOS if s.quick]
+        selected = [s for s in SCENARIOS if s.quick] + compiled
+    elif args.compiled:
+        # a bare --compiled runs exactly the compiled tier
+        selected = compiled
 
     extra_env: Optional[Dict[str, str]] = None
     worker_argv: Optional[List[str]] = None
